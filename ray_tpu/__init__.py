@@ -1,0 +1,145 @@
+"""ray_tpu — a TPU-native distributed AI runtime.
+
+Public API parity with the reference (``ray.*``): tasks, actors, a
+distributed object store with ownership-based memory management, placement
+groups / gang scheduling for TPU slices, plus the AI libraries
+(``ray_tpu.data`` / ``.train`` / ``.tune`` / ``.serve`` / ``.rl``) and the
+TPU-first parallelism layer (``ray_tpu.parallel`` / ``.ops`` / ``.models``).
+
+Importing ``ray_tpu`` does NOT import jax — the compute-path modules are
+lazy so runtime worker processes stay lightweight.
+"""
+
+from __future__ import annotations
+
+import inspect as _inspect
+from typing import Any, Optional
+
+from ray_tpu._version import version as __version__
+from ray_tpu.core import api as _api
+from ray_tpu.core.actor import ActorClass, ActorHandle, get_actor, kill, method
+from ray_tpu.core.api import init, is_initialized, shutdown
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.refs import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core.serialization import deregister_serializer, register_serializer
+from ray_tpu.core.task_spec import (
+    DefaultScheduling,
+    NodeAffinityScheduling,
+    NodeLabelScheduling,
+    PlacementGroupScheduling,
+    SpreadScheduling,
+    TaskOptions,
+)
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "method",
+    "ObjectRef",
+    "ActorHandle",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "free",
+    "timeline",
+    "__version__",
+]
+
+
+def remote(*args, **kwargs):
+    """``@ray_tpu.remote`` decorator for functions and classes.
+
+    Reference: ``ray.remote`` — bare (``@remote``) or parameterized
+    (``@remote(num_cpus=2, resources={"TPU": 4})``).
+    """
+
+    def make(obj):
+        opts = TaskOptions().merged_with(**kwargs)
+        if _inspect.isclass(obj):
+            return ActorClass(obj, opts)
+        return RemoteFunction(obj, opts)
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return make(args[0])
+    if args:
+        raise TypeError("remote() takes keyword arguments only")
+    return make
+
+
+def get(refs, *, timeout: Optional[float] = None):
+    return _api._global_worker().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return _api._global_worker().put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None, fetch_local: bool = True):
+    return _api._global_worker().wait(refs, num_returns, timeout, fetch_local)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    _api._global_worker().backend.cancel(ref, force, recursive)
+
+
+def free(refs) -> None:
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    _api._global_worker().backend.free([r.id() for r in refs])
+
+
+def nodes():
+    return _api._global_worker().backend.nodes()
+
+
+def cluster_resources():
+    return _api._global_worker().backend.cluster_resources()
+
+
+def available_resources():
+    return _api._global_worker().backend.available_resources()
+
+
+def list_named_actors(all_namespaces: bool = False):
+    return _api._global_worker().backend.list_named_actors(all_namespaces)
+
+
+def get_runtime_context():
+    from ray_tpu.core.runtime_context import RuntimeContext
+
+    return RuntimeContext(_api._global_worker())
+
+
+def timeline(filename: Optional[str] = None):
+    """Chrome-tracing dump of task events (cf. ``ray.timeline``)."""
+    from ray_tpu.observability.timeline import dump_timeline
+
+    return dump_timeline(filename)
+
+
+def __getattr__(name: str):
+    # Lazy AI-library subpackages (keep `import ray_tpu` jax-free).
+    if name in ("data", "train", "tune", "serve", "rl", "parallel", "ops", "models", "util", "dag"):
+        import importlib
+
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -1,0 +1,404 @@
+"""The driver/worker-side runtime core and public API implementation.
+
+Equivalent of the reference's ``python/ray/_private/worker.py``: a global
+``Worker`` owns the connection to a runtime backend, performs argument
+serialization/inlining on submit, creates return refs (ownership lives with
+the submitter — reference ownership model), and implements
+get/put/wait/kill/cancel on top of the backend.
+
+Two backends implement ``RuntimeBackend``:
+  * ``LocalBackend`` — in-process eager execution (``local_mode``).
+  * ``ClusterBackend`` — the real multiprocess runtime (controller + node
+    daemons + workers, shared-memory object store).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.exceptions import GetTimeoutError, TaskError
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.refs import Address, ObjectRef, set_refcount_hooks
+from ray_tpu.core.function_manager import FunctionTable
+from ray_tpu.core.task_spec import (
+    DefaultScheduling,
+    TaskKind,
+    TaskOptions,
+    TaskSpec,
+)
+
+
+class RuntimeBackend(ABC):
+    """What a runtime must provide to the API layer."""
+
+    @abstractmethod
+    def put_object(self, object_id: ObjectID, value: serialization.SerializedValue) -> None: ...
+
+    @abstractmethod
+    def get_objects(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]: ...
+
+    @abstractmethod
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int, timeout: Optional[float], fetch_local: bool) -> Tuple[List[ObjectRef], List[ObjectRef]]: ...
+
+    @abstractmethod
+    def submit_task(self, spec: TaskSpec) -> None: ...
+
+    @abstractmethod
+    def create_actor(self, spec: TaskSpec) -> None: ...
+
+    @abstractmethod
+    def submit_actor_task(self, spec: TaskSpec) -> None: ...
+
+    @abstractmethod
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None: ...
+
+    @abstractmethod
+    def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None: ...
+
+    @abstractmethod
+    def get_named_actor(self, name: str, namespace: str) -> Any: ...
+
+    @abstractmethod
+    def list_named_actors(self, all_namespaces: bool) -> List[Any]: ...
+
+    @abstractmethod
+    def kv_put(self, key: bytes, value: bytes) -> None: ...
+
+    @abstractmethod
+    def kv_get(self, key: bytes) -> Optional[bytes]: ...
+
+    @abstractmethod
+    def free(self, object_ids: Sequence[ObjectID]) -> None: ...
+
+    @abstractmethod
+    def add_local_ref(self, object_id: ObjectID) -> None: ...
+
+    @abstractmethod
+    def remove_local_ref(self, object_id: ObjectID) -> None: ...
+
+    @abstractmethod
+    def cluster_resources(self) -> Dict[str, float]: ...
+
+    @abstractmethod
+    def available_resources(self) -> Dict[str, float]: ...
+
+    @abstractmethod
+    def nodes(self) -> List[Dict[str, Any]]: ...
+
+    @abstractmethod
+    def shutdown(self) -> None: ...
+
+
+class Worker:
+    """Per-process runtime state (driver, worker, or local mode)."""
+
+    MODE_LOCAL = "local"
+    MODE_DRIVER = "driver"
+    MODE_WORKER = "worker"
+
+    def __init__(self, mode: str, backend: RuntimeBackend, job_id: JobID, namespace: str):
+        self.mode = mode
+        self.backend = backend
+        self.job_id = job_id
+        self.namespace = namespace
+        self.worker_id = WorkerID.from_random()
+        self.address: Optional[Address] = None  # set by cluster runtime
+        # Task context: the "current task" owns puts/submissions made here.
+        self._context = threading.local()
+        self._put_counter = 0
+        self._task_counter = 0
+        self._lock = threading.Lock()
+        self.fn_table = FunctionTable(backend.kv_put, backend.kv_get)
+        set_refcount_hooks(self._on_ref_created, self._on_ref_deleted, self._on_ref_borrowed)
+
+    # ---- task context --------------------------------------------------
+    @property
+    def current_task_id(self) -> TaskID:
+        tid = getattr(self._context, "task_id", None)
+        if tid is None:
+            tid = TaskID.for_driver(self.job_id)
+            self._context.task_id = tid
+        return tid
+
+    def set_task_context(self, task_id: TaskID) -> None:
+        self._context.task_id = task_id
+
+    # ---- refcounting hooks --------------------------------------------
+    def _on_ref_created(self, ref: ObjectRef) -> None:
+        try:
+            self.backend.add_local_ref(ref.id())
+        except Exception:
+            pass
+
+    def _on_ref_deleted(self, ref: ObjectRef) -> None:
+        try:
+            self.backend.remove_local_ref(ref.id())
+        except Exception:
+            pass
+
+    def _on_ref_borrowed(self, ref: ObjectRef) -> None:
+        try:
+            self.backend.add_local_ref(ref.id())
+        except Exception:
+            pass
+
+    # ---- object API ----------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("calling put on an ObjectRef is not allowed")
+        with self._lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        object_id = ObjectID.for_put(self.current_task_id, idx)
+        ser = serialization.serialize(value)
+        self.backend.put_object(object_id, ser)
+        return ObjectRef(object_id, self.address)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        if not all(isinstance(r, ObjectRef) for r in refs):
+            raise TypeError("get() expects an ObjectRef or a list of ObjectRefs")
+        values = self.backend.get_objects(refs, timeout)
+        out = []
+        for v in values:
+            if isinstance(v, Exception):
+                raise v
+            out.append(v)
+        return out[0] if single else out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        if isinstance(refs, ObjectRef):
+            raise TypeError("wait() expects a list of ObjectRefs")
+        if len(set(refs)) != len(refs):
+            raise ValueError("wait() got duplicate ObjectRefs")
+        if num_returns <= 0 or num_returns > len(refs):
+            raise ValueError(f"num_returns must be in [1, {len(refs)}]")
+        return self.backend.wait(list(refs), num_returns, timeout, fetch_local)
+
+    # ---- task submission ----------------------------------------------
+    def _serialize_args(self, args, kwargs):
+        """Inline small args; implicit-put large ones (reference
+        DependencyResolver inlining)."""
+        threshold = GLOBAL_CONFIG.max_direct_call_object_size
+        sargs = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                sargs.append(("ref", a))
+                continue
+            ser = serialization.serialize(a)
+            if ser.total_bytes <= threshold and not ser.contained_refs:
+                sargs.append(("val", ser.to_bytes()))
+            else:
+                ref = self._put_serialized(ser)
+                sargs.append(("ref", ref))
+        skwargs = []
+        for k, a in (kwargs or {}).items():
+            if isinstance(a, ObjectRef):
+                skwargs.append(("ref", k, a))
+                continue
+            ser = serialization.serialize(a)
+            if ser.total_bytes <= threshold and not ser.contained_refs:
+                skwargs.append(("val", k, ser.to_bytes()))
+            else:
+                ref = self._put_serialized(ser)
+                skwargs.append(("ref", k, ref))
+        return sargs, skwargs
+
+    def _put_serialized(self, ser: serialization.SerializedValue) -> ObjectRef:
+        with self._lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        object_id = ObjectID.for_put(self.current_task_id, idx)
+        self.backend.put_object(object_id, ser)
+        return ObjectRef(object_id, self.address)
+
+    def new_task_id(self) -> TaskID:
+        return TaskID.for_task(ActorID.nil_for_job(self.job_id))
+
+    def make_task_spec(
+        self,
+        kind: TaskKind,
+        function_obj: Any,
+        name: str,
+        args,
+        kwargs,
+        opts: TaskOptions,
+        *,
+        actor_id: Optional[ActorID] = None,
+        method_name: Optional[str] = None,
+        default_cpus: float = 1.0,
+    ) -> TaskSpec:
+        function_id = self.fn_table.export(function_obj)
+        task_id = self.new_task_id()
+        sargs, skwargs = self._serialize_args(args, kwargs)
+        num_returns = opts.num_returns if opts.num_returns is not None else 1
+        if isinstance(num_returns, int):
+            return_ids = [ObjectID.from_index(task_id, i + 1) for i in range(num_returns)]
+        else:
+            return_ids = [ObjectID.from_index(task_id, 1)]
+        max_retries = (
+            opts.max_retries
+            if opts.max_retries is not None
+            else (GLOBAL_CONFIG.task_max_retries if kind == TaskKind.NORMAL else 0)
+        )
+        return TaskSpec(
+            kind=kind,
+            task_id=task_id,
+            job_id=self.job_id,
+            name=name,
+            function_id=function_id,
+            args=sargs,
+            kwargs=skwargs,
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources=opts.resource_request(default_cpus).to_dict(),
+            scheduling_strategy=opts.scheduling_strategy,
+            owner=self.address,
+            max_retries=max_retries,
+            retry_exceptions=opts.retry_exceptions,
+            runtime_env=opts.runtime_env,
+            actor_id=actor_id,
+            max_restarts=opts.max_restarts,
+            max_task_retries=opts.max_task_retries,
+            max_concurrency=opts.max_concurrency or 1,
+            concurrency_groups=dict(opts.concurrency_groups),
+            actor_name=opts.name if kind == TaskKind.ACTOR_CREATION else None,
+            namespace=opts.namespace or self.namespace,
+            lifetime=opts.lifetime,
+            method_name=method_name,
+        )
+
+    def submit_task(self, function_obj, name, args, kwargs, opts: TaskOptions):
+        spec = self.make_task_spec(TaskKind.NORMAL, function_obj, name, args, kwargs, opts)
+        self.backend.submit_task(spec)
+        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids]
+        if spec.num_returns == 0:
+            return None
+        if spec.num_returns == 1:
+            return refs[0]
+        return refs
+
+    # ---- futures -------------------------------------------------------
+    def to_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            try:
+                fut.set_result(self.get(ref))
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    async def await_ref(self, ref: ObjectRef):
+        import asyncio
+
+        return await asyncio.wrap_future(self.to_future(ref))
+
+    def shutdown(self) -> None:
+        set_refcount_hooks(None, None, None)
+        self.backend.shutdown()
+
+
+# --- global worker singleton -------------------------------------------
+
+_worker: Optional[Worker] = None
+_worker_lock = threading.Lock()
+
+
+def _global_worker() -> Worker:
+    if _worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _worker
+
+
+def get_global_worker_or_none() -> Optional[Worker]:
+    return _worker
+
+
+def is_initialized() -> bool:
+    return _worker is not None
+
+
+def set_global_worker(worker: Optional[Worker]) -> None:
+    global _worker
+    _worker = worker
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    local_mode: bool = False,
+    namespace: Optional[str] = None,
+    object_store_memory: Optional[int] = None,
+    system_config: Optional[Dict[str, Any]] = None,
+    num_nodes: int = 1,
+    ignore_reinit_error: bool = False,
+) -> Dict[str, Any]:
+    """Start (or connect to) a runtime. Returns context info.
+
+    Reference: ``ray.init`` (``python/ray/_private/worker.py:1262``).
+    With no ``address`` a local cluster is started in-process
+    (controller + node daemon + workers); ``local_mode=True`` executes
+    everything eagerly in the driver process.
+    """
+    global _worker
+    with _worker_lock:
+        if _worker is not None:
+            if ignore_reinit_error:
+                return {"namespace": _worker.namespace}
+            raise RuntimeError("ray_tpu.init() called twice")
+        if system_config:
+            GLOBAL_CONFIG.apply_system_config(system_config)
+        if object_store_memory:
+            GLOBAL_CONFIG.object_store_memory_bytes = object_store_memory
+        import uuid
+
+        ns = namespace or uuid.uuid4().hex[:12]
+        job_id = JobID.from_random()
+        if local_mode:
+            from ray_tpu.core.local_backend import LocalBackend
+
+            backend = LocalBackend(num_cpus=num_cpus or 8, resources=resources)
+            _worker = Worker(Worker.MODE_LOCAL, backend, job_id, ns)
+            backend.bind_worker(_worker)
+        elif address is None:
+            from ray_tpu.core.cluster_backend import ClusterBackend
+
+            backend = ClusterBackend.start_cluster(
+                num_cpus=num_cpus, resources=resources, num_nodes=num_nodes
+            )
+            _worker = Worker(Worker.MODE_DRIVER, backend, job_id, ns)
+            backend.bind_worker(_worker)
+        else:
+            from ray_tpu.core.cluster_backend import ClusterBackend
+
+            backend = ClusterBackend.connect(address)
+            _worker = Worker(Worker.MODE_DRIVER, backend, job_id, ns)
+            backend.bind_worker(_worker)
+        atexit.register(shutdown)
+        return {"namespace": ns, "job_id": job_id.hex()}
+
+
+def shutdown() -> None:
+    global _worker
+    with _worker_lock:
+        if _worker is None:
+            return
+        try:
+            _worker.shutdown()
+        finally:
+            _worker = None

@@ -1,0 +1,112 @@
+"""Typed runtime configuration flags.
+
+Equivalent of the reference's RayConfig flag system
+(``src/ray/common/ray_config_def.h:18-22``): every flag has a type and a
+default, is overridable per-process via ``RAY_TPU_<name>`` environment
+variables, and cluster-wide via a ``system_config`` dict handed to
+``ray_tpu.init``. Flags are plain attributes on the singleton ``GlobalConfig``
+so hot paths read them without dict lookups.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class GlobalConfig:
+    # --- object store ---
+    object_store_memory_bytes: int = 2 * 1024**3
+    # Objects at or below this size are stored inline in the owner's
+    # in-process memory store and shipped inside RPC replies instead of
+    # going through shared memory (reference: task output inlining).
+    max_direct_call_object_size: int = 100 * 1024
+    # Chunk size for node-to-node object transfer (reference 5 MiB,
+    # ``ray_config_def.h:341``).
+    object_transfer_chunk_bytes: int = 5 * 1024**2
+    # Spill to disk when the store is above this fraction of capacity.
+    object_spilling_threshold: float = 0.8
+    object_spilling_dir: str = ""
+
+    # --- scheduling ---
+    # Hybrid policy: prefer local node until it exceeds this utilization
+    # fraction, then spread over the top-k best nodes (reference
+    # ``hybrid_scheduling_policy.h:50``).
+    scheduler_spread_threshold: float = 0.5
+    scheduler_top_k_fraction: float = 0.2
+    worker_lease_timeout_s: float = 30.0
+    # Max workers the pool will cold-start concurrently (startup tokens).
+    worker_maximum_startup_concurrency: int = 4
+    idle_worker_killing_time_s: float = 300.0
+    num_initial_workers: int = 0
+
+    # --- fault tolerance ---
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+    lineage_pinning_enabled: bool = True
+
+    # --- RPC ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_retry_base_delay_s: float = 0.05
+    rpc_retry_max_delay_s: float = 2.0
+    rpc_max_retries: int = 5
+
+    # --- task events / observability ---
+    task_events_buffer_size: int = 10000
+    task_events_flush_period_s: float = 1.0
+    metrics_report_period_s: float = 2.0
+
+    # --- testing / chaos ---
+    testing_rpc_failure: str = ""  # "method:failure_prob" fault injection
+
+    def reset(self) -> None:
+        for f in fields(self):
+            setattr(self, f.name, f.default)
+        self.apply_env()
+
+    def apply_env(self) -> None:
+        for f in fields(self):
+            env = os.environ.get(_ENV_PREFIX + f.name)
+            if env is None:
+                continue
+            setattr(self, f.name, _parse(env, f.type))
+
+    def apply_system_config(self, overrides: Dict[str, Any]) -> None:
+        valid = {f.name: f for f in fields(self)}
+        for key, value in overrides.items():
+            if key not in valid:
+                raise ValueError(f"unknown system_config key: {key!r}")
+            setattr(self, key, value)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+def _parse(raw: str, typ: Any) -> Any:
+    typ = str(typ)
+    if "bool" in typ:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if "int" in typ:
+        return int(raw)
+    if "float" in typ:
+        return float(raw)
+    return raw
+
+
+GLOBAL_CONFIG = GlobalConfig()
+GLOBAL_CONFIG.apply_env()
+
+
+def serialize_config() -> str:
+    return json.dumps(GLOBAL_CONFIG.to_dict())
+
+
+def load_config(serialized: str) -> None:
+    GLOBAL_CONFIG.apply_system_config(json.loads(serialized))

@@ -1,0 +1,94 @@
+"""Public exception types (cf. reference ``python/ray/exceptions.py``)."""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised; re-raised at ``get`` with the remote traceback.
+
+    Reference: ``RayTaskError`` — the error object is stored in place of
+    the task's return value and surfaces on every dependent get.
+    """
+
+    def __init__(self, function_name: str, cause: BaseException, tb: Optional[str] = None):
+        self.function_name = function_name
+        self.cause = cause
+        self.remote_traceback = tb or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        super().__init__(function_name, cause)
+
+    def __str__(self) -> str:
+        return (
+            f"task {self.function_name} failed with "
+            f"{type(self.cause).__name__}: {self.cause}\n"
+            f"remote traceback:\n{self.remote_traceback}"
+        )
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died (cf. ``WorkerCrashedError``)."""
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead and will not be restarted (cf. ``RayActorError``)."""
+
+    def __init__(self, actor_id=None, reason: str = ""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"actor {actor_id} died: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object's value was lost and could not be reconstructed."""
+
+    def __init__(self, object_id=None, reason: str = ""):
+        self.object_id = object_id
+        super().__init__(f"object {object_id} lost: {reason}")
+
+
+class ObjectFreedError(RayTpuError):
+    """Object was explicitly freed by its owner."""
+
+
+class OwnerDiedError(ObjectLostError):
+    """The worker that owned this object died (cf. ``OwnerDiedError``)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get`` exceeded its timeout (cf. ``GetTimeoutError``)."""
+
+
+class TaskCancelledError(RayTpuError):
+    """Task was cancelled (cf. ``TaskCancelledError``)."""
+
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"task {task_id} was cancelled")
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor's max_pending_calls was exceeded."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Runtime environment failed to build."""
+
+
+class NodeDiedError(RayTpuError):
+    """The node hosting the operation died."""

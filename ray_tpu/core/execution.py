@@ -1,0 +1,79 @@
+"""Task execution helpers shared by the local backend and cluster workers.
+
+Server side of the task hot path (reference: Cython
+``task_execution_handler`` ``_raylet.pyx:2239`` feeding the user function,
+wrapping exceptions, and fanning results out to the store).
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+from typing import Any, Callable, List, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.refs import ObjectRef
+from ray_tpu.core.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+def resolve_args(spec: TaskSpec, get_ref: Callable[[ObjectRef], Any]) -> Tuple[list, dict]:
+    """Materialize positional/keyword args: refs via `get_ref`, inline
+    values via deserialization (they were serialized at submit)."""
+    args = []
+    for tag, payload in spec.args:
+        if tag == "ref":
+            args.append(get_ref(payload))
+        else:
+            args.append(serialization.deserialize_bytes(payload))
+    kwargs = {}
+    for tag, key, payload in spec.kwargs:
+        if tag == "ref":
+            kwargs[key] = get_ref(payload)
+        else:
+            kwargs[key] = serialization.deserialize_bytes(payload)
+    return args, kwargs
+
+
+def unpack_returns(spec: TaskSpec, result: Any) -> List[Tuple[ObjectID, Any]]:
+    """Split a function result across the task's return object ids."""
+    n = spec.num_returns
+    if n == 0:
+        return []
+    if n == 1:
+        return [(spec.return_ids[0], result)]
+    if isinstance(n, int):
+        try:
+            values = list(result)
+        except TypeError:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={n} but returned "
+                f"non-iterable {type(result).__name__}"
+            )
+        if len(values) != n:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={n} but returned "
+                f"{len(values)} values"
+            )
+        return list(zip(spec.return_ids, values))
+    raise NotImplementedError(f"num_returns={n!r}")
+
+
+def run_function(spec: TaskSpec, fn: Callable, args: list, kwargs: dict) -> List[Tuple[ObjectID, Any]]:
+    """Invoke `fn`; on user exception return TaskError placeholders for every
+    return id (stored in place of values, surfacing at get — reference
+    behavior)."""
+    try:
+        result = fn(*args, **kwargs)
+        if inspect.iscoroutine(result):
+            import asyncio
+
+            result = asyncio.get_event_loop().run_until_complete(result)
+        return unpack_returns(spec, result)
+    except Exception as e:  # noqa: BLE001 - user code boundary
+        err = TaskError(spec.name, e)
+        ids = spec.return_ids if spec.num_returns != 0 else []
+        return [(oid, err) for oid in ids]

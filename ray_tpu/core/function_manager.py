@@ -1,0 +1,54 @@
+"""Function/class export and import.
+
+Equivalent of the reference's function manager
+(``python/ray/_private/function_manager.py``): the driver pickles each
+remote function/class once, stores it in the control plane's KV under a
+content hash, and ships only the hash inside task specs; workers import and
+cache on first use. In local mode the "KV" is a process-local dict.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+
+FUNCTION_KV_PREFIX = b"fn:"
+
+
+class FunctionTable:
+    """Client-side view of the exported-function table."""
+
+    def __init__(self, kv_put: Callable[[bytes, bytes], None], kv_get: Callable[[bytes], Optional[bytes]]):
+        self._kv_put = kv_put
+        self._kv_get = kv_get
+        self._exported: Dict[bytes, bytes] = {}
+        self._cache: Dict[bytes, Any] = {}
+        self._lock = threading.Lock()
+
+    def export(self, obj: Any) -> bytes:
+        """Pickle `obj` (function or class), store under its hash, return id."""
+        payload = cloudpickle.dumps(obj)
+        function_id = hashlib.sha256(payload).digest()[:16]
+        with self._lock:
+            if function_id in self._exported:
+                return function_id
+            self._exported[function_id] = payload
+            self._cache[function_id] = obj
+        self._kv_put(FUNCTION_KV_PREFIX + function_id, payload)
+        return function_id
+
+    def load(self, function_id: bytes) -> Any:
+        with self._lock:
+            hit = self._cache.get(function_id)
+        if hit is not None:
+            return hit
+        payload = self._kv_get(FUNCTION_KV_PREFIX + function_id)
+        if payload is None:
+            raise KeyError(f"function {function_id.hex()} not exported")
+        obj = cloudpickle.loads(payload)
+        with self._lock:
+            self._cache[function_id] = obj
+        return obj

@@ -1,0 +1,180 @@
+"""Binary identifiers with embedded lineage.
+
+Design (cf. reference ``src/ray/common/id.h``): every id is a fixed-width
+byte string; larger ids embed smaller ones so ownership and lineage can be
+recovered from the id alone:
+
+    JobID (4B)  ⊂  ActorID (12B = 8B unique + JobID)
+    ActorID     ⊂  TaskID  (20B = 8B unique + ActorID)
+    TaskID      ⊂  ObjectID (24B = TaskID + 4B little-endian return index)
+
+``ObjectID.for_put`` uses index 0 with a synthetic "put" task id; task
+returns use index >= 1 (reference: ``ObjectID::FromIndex``). Ids are
+immutable, hashable, msgpack-friendly (raw bytes), and render as hex.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_JOB_UNIQUE = 4
+_ACTOR_UNIQUE = 8
+_TASK_UNIQUE = 8
+
+JOB_ID_SIZE = _JOB_UNIQUE
+ACTOR_ID_SIZE = _ACTOR_UNIQUE + JOB_ID_SIZE  # 12
+TASK_ID_SIZE = _TASK_UNIQUE + ACTOR_ID_SIZE  # 20
+OBJECT_ID_SIZE = TASK_ID_SIZE + 4  # 24
+NODE_ID_SIZE = 16
+WORKER_ID_SIZE = 16
+PLACEMENT_GROUP_ID_SIZE = 12
+
+
+class BaseID:
+    """Immutable fixed-width binary id."""
+
+    SIZE = 0
+    __slots__ = ("_bytes",)
+
+    def __init__(self, binary: bytes):
+        if not isinstance(binary, (bytes, bytearray)):
+            raise TypeError(f"{type(self).__name__} expects bytes, got {type(binary)}")
+        binary = bytes(binary)
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} expects {self.SIZE} bytes, got {len(binary)}"
+            )
+        self._bytes = binary
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\x00" * cls.SIZE)
+
+    @classmethod
+    def from_hex(cls, hex_str: str) -> "BaseID":
+        return cls(bytes.fromhex(hex_str))
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other) and self._bytes == other._bytes
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self._bytes))
+
+    def __lt__(self, other) -> bool:
+        return self._bytes < other._bytes
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = JOB_ID_SIZE
+    __slots__ = ()
+
+    _counter_lock = threading.Lock()
+    _counter = 0
+
+    @classmethod
+    def from_index(cls, index: int) -> "JobID":
+        return cls(index.to_bytes(cls.SIZE, "little"))
+
+
+class NodeID(BaseID):
+    SIZE = NODE_ID_SIZE
+    __slots__ = ()
+
+
+class WorkerID(BaseID):
+    SIZE = WORKER_ID_SIZE
+    __slots__ = ()
+
+
+class PlacementGroupID(BaseID):
+    SIZE = PLACEMENT_GROUP_ID_SIZE
+    __slots__ = ()
+
+
+class ActorID(BaseID):
+    SIZE = ACTOR_ID_SIZE
+    __slots__ = ()
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(os.urandom(_ACTOR_UNIQUE) + job_id.binary())
+
+    @classmethod
+    def nil_for_job(cls, job_id: JobID) -> "ActorID":
+        """The actor id used for non-actor tasks of a job."""
+        return cls(b"\x00" * _ACTOR_UNIQUE + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[_ACTOR_UNIQUE:])
+
+
+class TaskID(BaseID):
+    SIZE = TASK_ID_SIZE
+    __slots__ = ()
+
+    @classmethod
+    def for_task(cls, actor_id: ActorID) -> "TaskID":
+        return cls(os.urandom(_TASK_UNIQUE) + actor_id.binary())
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        """The synthetic task id of a driver (owns driver-created objects)."""
+        return cls(b"\xff" * _TASK_UNIQUE + ActorID.nil_for_job(job_id).binary())
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[_TASK_UNIQUE:])
+
+    def job_id(self) -> JobID:
+        return self.actor_id().job_id()
+
+
+class ObjectID(BaseID):
+    SIZE = OBJECT_ID_SIZE
+    __slots__ = ()
+
+    MAX_INDEX = 2**32 - 1
+
+    @classmethod
+    def from_index(cls, task_id: TaskID, index: int) -> "ObjectID":
+        """The i-th return of `task_id` (index >= 1; 0 reserved for puts)."""
+        if not 0 <= index <= cls.MAX_INDEX:
+            raise ValueError(f"object index out of range: {index}")
+        return cls(task_id.binary() + index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # Puts share the task id namespace; flip the high bit of the index
+        # so put ids never collide with return ids.
+        return cls(task_id.binary() + (0x80000000 | put_index).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:TASK_ID_SIZE])
+
+    def index(self) -> int:
+        return int.from_bytes(self._bytes[TASK_ID_SIZE:], "little")
+
+    def is_put(self) -> bool:
+        return bool(self.index() & 0x80000000)
+
+    def job_id(self) -> JobID:
+        return self.task_id().job_id()

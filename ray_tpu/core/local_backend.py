@@ -1,0 +1,225 @@
+"""In-process eager backend (``local_mode=True``).
+
+Reference: ``python/ray/_private/worker.py`` LOCAL_MODE — tasks run
+synchronously in the driver; actors are plain in-process objects. Values
+still round-trip through the serializer so local mode catches serialization
+bugs, matching reference behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core import execution, serialization
+from ray_tpu.core.api import RuntimeBackend, Worker
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskError,
+)
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.refs import ObjectRef
+from ray_tpu.core.task_spec import TaskSpec
+
+
+class LocalBackend(RuntimeBackend):
+    def __init__(self, num_cpus: float = 8, resources: Optional[Dict[str, float]] = None):
+        self._resources = {"CPU": num_cpus, **(resources or {})}
+        self._store: Dict[ObjectID, Any] = {}  # bytes | TaskError
+        self._kv: Dict[bytes, bytes] = {}
+        self._actors: Dict[ActorID, Any] = {}
+        self._actor_locks: Dict[ActorID, threading.RLock] = {}
+        self._dead_actors: Dict[ActorID, str] = {}
+        self._named: Dict[Tuple[str, str], Tuple[ActorID, dict, Any]] = {}
+        self._refcounts: Dict[ObjectID, int] = {}
+        self._lock = threading.RLock()
+        self._worker: Optional[Worker] = None
+
+    def bind_worker(self, worker: Worker) -> None:
+        self._worker = worker
+
+    # ---- objects -------------------------------------------------------
+    def put_object(self, object_id: ObjectID, value: serialization.SerializedValue) -> None:
+        with self._lock:
+            self._store[object_id] = value.to_bytes()
+
+    def _store_result(self, object_id: ObjectID, value: Any) -> None:
+        if isinstance(value, TaskError):
+            self._store[object_id] = value
+        else:
+            self._store[object_id] = serialization.serialize(value).to_bytes()
+
+    def _lookup(self, ref: ObjectRef) -> Any:
+        with self._lock:
+            data = self._store.get(ref.id())
+        if data is None:
+            raise KeyError(f"object {ref.hex()} not found (local mode)")
+        if isinstance(data, Exception):
+            return data
+        return serialization.deserialize_bytes(data)
+
+    def get_objects(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        return [self._lookup(r) for r in refs]
+
+    def wait(self, refs, num_returns, timeout, fetch_local):
+        with self._lock:
+            ready = [r for r in refs if r.id() in self._store]
+        ready = ready[:num_returns]
+        not_ready = [r for r in refs if r not in ready]
+        return ready, not_ready
+
+    def free(self, object_ids: Sequence[ObjectID]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                self._store.pop(oid, None)
+
+    def add_local_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._refcounts[object_id] = self._refcounts.get(object_id, 0) + 1
+
+    def remove_local_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            n = self._refcounts.get(object_id, 0) - 1
+            if n <= 0:
+                self._refcounts.pop(object_id, None)
+                self._store.pop(object_id, None)
+            else:
+                self._refcounts[object_id] = n
+
+    # ---- tasks ---------------------------------------------------------
+    def _get_ref_value(self, ref: ObjectRef) -> Any:
+        value = self._lookup(ref)
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    def submit_task(self, spec: TaskSpec) -> None:
+        fn = self._worker.fn_table.load(spec.function_id)
+        try:
+            args, kwargs = execution.resolve_args(spec, self._get_ref_value)
+        except TaskError as e:
+            # Dependency failed: propagate to our returns (reference:
+            # error propagation through lineage).
+            with self._lock:
+                for oid in spec.return_ids:
+                    self._store[oid] = e
+            return
+        results = execution.run_function(spec, fn, args, kwargs)
+        with self._lock:
+            for oid, value in results:
+                self._store_result(oid, value)
+
+    # ---- actors --------------------------------------------------------
+    def create_actor(self, spec: TaskSpec) -> None:
+        cls = self._worker.fn_table.load(spec.function_id)
+        name_key = None
+        if spec.actor_name:
+            name_key = (spec.namespace or "", spec.actor_name)
+            with self._lock:
+                if name_key in self._named:
+                    raise ValueError(
+                        f"actor name {spec.actor_name!r} already taken in "
+                        f"namespace {spec.namespace!r}"
+                    )
+        try:
+            args, kwargs = execution.resolve_args(spec, self._get_ref_value)
+            instance = cls(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            self._dead_actors[spec.actor_id] = f"creation failed: {e!r}"
+            return
+        with self._lock:
+            self._actors[spec.actor_id] = instance
+            self._actor_locks[spec.actor_id] = threading.RLock()
+            if name_key:
+                self._named[name_key] = (spec.actor_id, spec.method_opts, spec.owner)
+
+    def submit_actor_task(self, spec: TaskSpec) -> None:
+        aid = spec.actor_id
+        with self._lock:
+            instance = self._actors.get(aid)
+        if instance is None:
+            reason = self._dead_actors.get(aid, "actor not found")
+            err = ActorDiedError(aid, reason)
+            with self._lock:
+                for oid in spec.return_ids:
+                    self._store[oid] = err
+            return
+        if spec.method_name == "__ray_ready__":
+            with self._lock:
+                self._store_result(spec.return_ids[0], True)
+            return
+        if spec.method_name == "__ray_terminate__":
+            self.kill_actor(aid, no_restart=True)
+            with self._lock:
+                self._store_result(spec.return_ids[0], None)
+            return
+        fn = getattr(instance, spec.method_name)
+        try:
+            args, kwargs = execution.resolve_args(spec, self._get_ref_value)
+        except TaskError as e:
+            with self._lock:
+                for oid in spec.return_ids:
+                    self._store[oid] = e
+            return
+        with self._actor_locks[aid]:
+            results = execution.run_function(spec, fn, args, kwargs)
+        with self._lock:
+            for oid, value in results:
+                self._store_result(oid, value)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        with self._lock:
+            self._actors.pop(actor_id, None)
+            self._actor_locks.pop(actor_id, None)
+            self._dead_actors[actor_id] = "killed via kill()"
+            self._named = {
+                k: v for k, v in self._named.items() if v[0] != actor_id
+            }
+
+    def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
+        pass  # everything already ran (eager local mode)
+
+    def get_named_actor(self, name: str, namespace: str):
+        with self._lock:
+            return self._named.get((namespace or "", name))
+
+    def list_named_actors(self, all_namespaces: bool) -> List[Any]:
+        with self._lock:
+            if all_namespaces:
+                return [
+                    {"name": k[1], "namespace": k[0]} for k in self._named
+                ]
+            ns = self._worker.namespace if self._worker else ""
+            return [{"name": k[1], "namespace": k[0]} for k in self._named if k[0] == ns]
+
+    # ---- kv / cluster --------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return dict(self._resources)
+
+    def available_resources(self) -> Dict[str, float]:
+        return dict(self._resources)
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "NodeID": "local",
+                "Alive": True,
+                "Resources": dict(self._resources),
+            }
+        ]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._store.clear()
+            self._actors.clear()
+            self._named.clear()

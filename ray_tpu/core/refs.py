@@ -1,0 +1,122 @@
+"""ObjectRef: a distributed future with an owner address.
+
+Design (cf. reference ``ObjectRef`` in ``_raylet.pyx`` + ownership model in
+``core_worker/reference_count.h:64``): a ref carries its ``ObjectID`` plus
+the *owner* worker's address. The owner is the process that created the
+object (by ``put`` or by submitting the producing task); it holds the
+authoritative reference count, the value-or-location, and the lineage needed
+for reconstruction. Any process holding a ref can resolve it by asking the
+owner; deserializing a ref into a new process registers that process as a
+*borrower* with the owner.
+
+Refs deregister themselves on ``__del__`` through the ambient runtime (if
+one is connected), driving distributed GC.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID, WorkerID
+
+
+@dataclass(frozen=True)
+class Address:
+    """Location of a worker's RPC endpoint."""
+
+    worker_id: bytes  # WorkerID binary
+    node_id: bytes  # NodeID binary
+    host: str
+    port: int
+
+    def key(self):
+        return (self.worker_id, self.host, self.port)
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "_skip_refcount", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: Optional[Address] = None, *, _skip_refcount: bool = False):
+        self._id = object_id
+        self._owner = owner
+        self._skip_refcount = _skip_refcount
+        if not _skip_refcount:
+            _runtime_add_local_ref(self)
+
+    # -- identity --------------------------------------------------------
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    @property
+    def owner_address(self) -> Optional[Address]:
+        return self._owner
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and self._id == other._id
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id.hex()})"
+
+    # -- pickling: travels with owner address; registers borrower --------
+    def __reduce__(self):
+        return (_deserialize_ref, (self._id.binary(), self._owner))
+
+    # -- lifecycle -------------------------------------------------------
+    def __del__(self):
+        if not self._skip_refcount:
+            _runtime_remove_local_ref(self)
+
+    # -- ergonomics ------------------------------------------------------
+    def future(self):
+        """A concurrent.futures.Future resolving to the value."""
+        from ray_tpu.core.api import _global_worker
+
+        return _global_worker().to_future(self)
+
+    def __await__(self):
+        from ray_tpu.core.api import _global_worker
+
+        return _global_worker().await_ref(self).__await__()
+
+
+def _deserialize_ref(binary: bytes, owner: Optional[Address]) -> ObjectRef:
+    ref = ObjectRef(ObjectID(binary), owner, _skip_refcount=True)
+    _runtime_register_borrow(ref)
+    return ref
+
+
+# --- hooks into the ambient runtime (set by api.init) -------------------
+
+_hooks = {"add": None, "remove": None, "borrow": None}
+
+
+def set_refcount_hooks(add, remove, borrow) -> None:
+    _hooks["add"], _hooks["remove"], _hooks["borrow"] = add, remove, borrow
+
+
+def _runtime_add_local_ref(ref: ObjectRef) -> None:
+    if _hooks["add"] is not None:
+        _hooks["add"](ref)
+
+
+def _runtime_remove_local_ref(ref: ObjectRef) -> None:
+    if _hooks["remove"] is not None:
+        try:
+            _hooks["remove"](ref)
+        except Exception:
+            pass  # interpreter shutdown
+
+
+def _runtime_register_borrow(ref: ObjectRef) -> None:
+    if _hooks["borrow"] is not None:
+        _hooks["borrow"](ref)

@@ -1,0 +1,42 @@
+"""``@remote`` functions (cf. reference ``python/ray/remote_function.py``)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+from ray_tpu.core.api import _global_worker
+from ray_tpu.core.task_spec import TaskOptions
+
+
+class RemoteFunction:
+    def __init__(self, function: Callable, opts: Optional[TaskOptions] = None):
+        if not callable(function):
+            raise TypeError("@remote requires a callable")
+        self._function = function
+        self._opts = opts or TaskOptions()
+        self._name = getattr(function, "__qualname__", getattr(function, "__name__", "fn"))
+        functools.update_wrapper(self, function, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._name}() cannot be called directly; "
+            f"use {self._name}.remote()"
+        )
+
+    def remote(self, *args, **kwargs):
+        return _global_worker().submit_task(self._function, self._name, args, kwargs, self._opts)
+
+    def options(self, **updates) -> "RemoteFunction":
+        return RemoteFunction(self._function, self._opts.merged_with(**updates))
+
+    def bind(self, *args, **kwargs):
+        """DAG-node construction (compiled graphs)."""
+        from ray_tpu.dag.node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
+    @property
+    def func(self) -> Callable:
+        """The underlying (undecorated) function."""
+        return self._function

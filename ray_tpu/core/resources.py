@@ -1,0 +1,161 @@
+"""Cluster resource model.
+
+Equivalent of the reference's scheduling resource types
+(``src/ray/common/scheduling/``): named resources held as fixed-point
+integers (1 unit = 1/10000) so fractional requests compose without float
+drift; ``NodeResources`` tracks total vs. available; ``ResourceRequest`` is
+what a task/actor/bundle demands.
+
+TPU-first addition: the well-known resource names include ``TPU`` (chips on
+a host) and per-topology slice head resources like ``TPU-v5e-8-head`` which
+gang-scheduling uses to place exactly one coordinator per pod slice
+(cf. reference ``python/ray/_private/accelerators/tpu.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Mapping, Optional
+
+PRECISION = 10_000
+
+CPU = "CPU"
+TPU = "TPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+# Shadow-resource naming for placement group bundles (reference:
+# ``CPU_group_<pgid>`` in ``raylet/placement_group_resource_manager.cc``).
+def pg_resource_name(resource: str, pg_id_hex: str, bundle_index: Optional[int] = None) -> str:
+    if bundle_index is None:
+        return f"{resource}_group_{pg_id_hex}"
+    return f"{resource}_group_{bundle_index}_{pg_id_hex}"
+
+
+def is_pg_resource(name: str) -> bool:
+    return "_group_" in name
+
+
+def tpu_slice_head_resource(topology: str) -> str:
+    """e.g. ``TPU-v5e-8-head``: one per slice, claimed by the gang leader."""
+    return f"TPU-{topology}-head"
+
+
+def to_fixed(value: float) -> int:
+    return round(value * PRECISION)
+
+
+def from_fixed(value: int) -> float:
+    return value / PRECISION
+
+
+class ResourceSet:
+    """Immutable-ish map of resource name -> fixed-point amount (> 0)."""
+
+    __slots__ = ("_map",)
+
+    def __init__(self, amounts: Optional[Mapping[str, float]] = None, _fixed: Optional[Dict[str, int]] = None):
+        if _fixed is not None:
+            self._map = {k: v for k, v in _fixed.items() if v > 0}
+        else:
+            self._map = {}
+            for name, value in (amounts or {}).items():
+                if value < 0:
+                    raise ValueError(f"negative resource {name}: {value}")
+                fixed = to_fixed(value)
+                if fixed > 0:
+                    self._map[name] = fixed
+
+    def get(self, name: str) -> float:
+        return from_fixed(self._map.get(name, 0))
+
+    def get_fixed(self, name: str) -> int:
+        return self._map.get(name, 0)
+
+    def names(self) -> Iterable[str]:
+        return self._map.keys()
+
+    def is_empty(self) -> bool:
+        return not self._map
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: from_fixed(v) for k, v in self._map.items()}
+
+    def fixed_items(self):
+        return self._map.items()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ResourceSet) and self._map == other._map
+
+    def __repr__(self) -> str:
+        return f"ResourceSet({self.to_dict()})"
+
+    def covers(self, request: "ResourceSet") -> bool:
+        return all(self._map.get(k, 0) >= v for k, v in request._map.items())
+
+    def add(self, other: "ResourceSet") -> "ResourceSet":
+        out = dict(self._map)
+        for k, v in other._map.items():
+            out[k] = out.get(k, 0) + v
+        return ResourceSet(_fixed=out)
+
+    def subtract(self, other: "ResourceSet", allow_negative: bool = False) -> "ResourceSet":
+        out = dict(self._map)
+        for k, v in other._map.items():
+            nv = out.get(k, 0) - v
+            if nv < 0 and not allow_negative:
+                raise ValueError(f"resource {k} would go negative")
+            if nv <= 0:
+                out.pop(k, None)
+            else:
+                out[k] = nv
+        return ResourceSet(_fixed=out)
+
+
+class NodeResources:
+    """Total and available resources of one node, plus node labels."""
+
+    __slots__ = ("total", "available", "labels")
+
+    def __init__(self, total: ResourceSet, labels: Optional[Dict[str, str]] = None):
+        self.total = total
+        self.available = ResourceSet(_fixed=dict(total.fixed_items()))
+        self.labels = labels or {}
+
+    def can_fit(self, request: ResourceSet) -> bool:
+        return self.available.covers(request)
+
+    def could_ever_fit(self, request: ResourceSet) -> bool:
+        return self.total.covers(request)
+
+    def allocate(self, request: ResourceSet) -> None:
+        self.available = self.available.subtract(request)
+
+    def release(self, request: ResourceSet) -> None:
+        self.available = self.available.add(request)
+        # Clamp to total (release after total shrank, e.g. PG removal).
+        clamped = {}
+        for k, v in self.available.fixed_items():
+            clamped[k] = min(v, self.total.get_fixed(k)) if self.total.get_fixed(k) else v
+        self.available = ResourceSet(_fixed=clamped)
+
+    def add_total(self, extra: ResourceSet) -> None:
+        self.total = self.total.add(extra)
+        self.available = self.available.add(extra)
+
+    def remove_total(self, extra: ResourceSet) -> None:
+        self.total = self.total.subtract(extra, allow_negative=True)
+        self.available = self.available.subtract(extra, allow_negative=True)
+
+    def utilization(self) -> float:
+        """Max over resources of used/total — the hybrid policy's node score
+        (reference ``scorer.h:41`` LeastResourceScorer)."""
+        worst = 0.0
+        for name, total in self.total.fixed_items():
+            if is_pg_resource(name) or total <= 0:
+                continue
+            used = total - self.available.get_fixed(name)
+            worst = max(worst, used / total)
+        return worst
+
+    def to_dict(self) -> Dict[str, Dict[str, float]]:
+        return {"total": self.total.to_dict(), "available": self.available.to_dict()}

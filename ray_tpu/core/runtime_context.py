@@ -1,0 +1,34 @@
+"""Runtime context (cf. reference ``ray.runtime_context.RuntimeContext``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class RuntimeContext:
+    def __init__(self, worker):
+        self._worker = worker
+
+    def get_job_id(self) -> str:
+        return self._worker.job_id.hex()
+
+    def get_worker_id(self) -> str:
+        return self._worker.worker_id.hex()
+
+    def get_task_id(self) -> Optional[str]:
+        return self._worker.current_task_id.hex()
+
+    def get_actor_id(self) -> Optional[str]:
+        aid = getattr(self._worker._context, "actor_id", None)
+        return aid.hex() if aid is not None else None
+
+    def get_node_id(self) -> Optional[str]:
+        addr = self._worker.address
+        return addr.node_id.hex() if addr else None
+
+    @property
+    def namespace(self) -> str:
+        return self._worker.namespace
+
+    def get_assigned_resources(self):
+        return getattr(self._worker._context, "resources", {})

@@ -1,0 +1,149 @@
+"""Value serialization for the object store and RPC payloads.
+
+Equivalent of the reference's ``python/ray/_private/serialization.py``:
+cloudpickle for arbitrary Python objects with pickle-protocol-5 out-of-band
+buffers so numpy (and host-side jax) arrays are written/read zero-copy
+against shared memory. Wire format:
+
+    [u32 nbuffers] [u64 len_meta] [meta pickle] ([u64 len_i] [buffer_i])*
+
+``ObjectRef``s nested inside values are extracted during serialization so
+the ownership layer can track borrowers (reference: ``serialization.py``
+contained-object-ref accounting), and re-hydrated on deserialization.
+
+jax.Array values are converted to numpy on serialize via ``__array__`` —
+device buffers never pass through the object store in round 1; the
+device-to-device path is the collective/ICI layer's job.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+import cloudpickle
+import numpy as np
+
+_HEADER = struct.Struct("<IQ")
+_LEN = struct.Struct("<Q")
+
+# Registered custom (reducer, reconstructor) pairs, keyed by type.
+_custom_serializers: Dict[Type, Tuple[Callable, Callable]] = {}
+_lock = threading.Lock()
+
+
+def register_serializer(cls: Type, *, serializer: Callable, deserializer: Callable) -> None:
+    """Same contract as reference ``ray.util.register_serializer``."""
+    with _lock:
+        _custom_serializers[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls: Type) -> None:
+    with _lock:
+        _custom_serializers.pop(cls, None)
+
+
+def _reconstruct_custom(cls_bytes: bytes, payload: Any) -> Any:
+    cls = cloudpickle.loads(cls_bytes)
+    pair = _custom_serializers.get(cls)
+    if pair is None:
+        raise ValueError(f"no deserializer registered for {cls}")
+    return pair[1](payload)
+
+
+class SerializedValue:
+    """A serialized value: metadata bytes + out-of-band buffers + refs."""
+
+    __slots__ = ("meta", "buffers", "contained_refs")
+
+    def __init__(self, meta: bytes, buffers: List, contained_refs: List):
+        self.meta = meta
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        return (
+            _HEADER.size
+            + len(self.meta)
+            + sum(_LEN.size + len(memoryview(b).cast("B")) for b in self.buffers)
+        )
+
+    def to_bytes(self) -> bytes:
+        out = bytearray()
+        self.write_into(out)
+        return bytes(out)
+
+    def write_into(self, buf) -> None:
+        buf += _HEADER.pack(len(self.buffers), len(self.meta))
+        buf += self.meta
+        for b in self.buffers:
+            mv = memoryview(b).cast("B")
+            buf += _LEN.pack(len(mv))
+            buf += mv
+
+
+def _find_custom(obj: Any) -> Optional[Tuple[Type, Tuple[Callable, Callable]]]:
+    for cls, pair in _custom_serializers.items():
+        if isinstance(obj, cls):
+            return cls, pair
+    return None
+
+
+def serialize(value: Any) -> SerializedValue:
+    from ray_tpu.core.refs import ObjectRef  # cycle: refs uses serialization
+
+    buffers: List = []
+    contained: List = []
+
+    def reducer(obj):
+        if isinstance(obj, ObjectRef):
+            contained.append(obj)
+            return None  # fall through to cloudpickle's default handling
+        if _custom_serializers:
+            hit = _find_custom(obj)
+            if hit is not None:
+                cls, (ser, _de) = hit
+                return (_reconstruct_custom, (cloudpickle.dumps(cls), ser(obj)))
+        return None
+
+    # jax.Array → numpy before pickling (duck-typed to avoid importing jax).
+    mod = type(value).__module__ or ""
+    if mod.startswith("jaxlib") or mod.startswith("jax"):
+        if hasattr(value, "__array__"):
+            value = np.asarray(value)
+
+    class _Pickler(cloudpickle.CloudPickler):
+        def reducer_override(self, obj):
+            rv = reducer(obj)
+            if rv is not None:
+                return rv
+            return super().reducer_override(obj)
+
+    import io
+
+    f = io.BytesIO()
+    p = _Pickler(f, protocol=5, buffer_callback=buffers.append)
+    p.dump(value)
+    return SerializedValue(f.getvalue(), buffers, contained)
+
+
+def deserialize(meta: bytes, buffers: List) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
+def deserialize_bytes(data) -> Any:
+    mv = memoryview(data)
+    nbuf, meta_len = _HEADER.unpack_from(mv, 0)
+    off = _HEADER.size
+    meta = bytes(mv[off : off + meta_len])
+    off += meta_len
+    buffers = []
+    for _ in range(nbuf):
+        (blen,) = _LEN.unpack_from(mv, off)
+        off += _LEN.size
+        buffers.append(pickle.PickleBuffer(mv[off : off + blen]))
+        off += blen
+    return deserialize(meta, buffers)

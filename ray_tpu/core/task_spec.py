@@ -1,0 +1,151 @@
+"""Task / actor specifications and call options.
+
+Equivalent of the reference's ``TaskSpecification``
+(``src/ray/common/task/task_spec.h``) plus the normalized ``.options(...)``
+surface (``python/ray/remote_function.py:189``, ``python/ray/actor.py``).
+Specs are plain picklable dataclasses; function/class bodies travel by
+export-id through the control plane's KV (function-manager pattern,
+reference ``_private/function_manager.py``), never inside the spec.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu.core.refs import Address, ObjectRef
+from ray_tpu.core.resources import ResourceSet
+
+
+class SchedulingStrategy:
+    """Base for scheduling strategies (cf. ``util/scheduling_strategies.py``)."""
+
+
+@dataclass(frozen=True)
+class DefaultScheduling(SchedulingStrategy):
+    pass
+
+
+@dataclass(frozen=True)
+class SpreadScheduling(SchedulingStrategy):
+    pass
+
+
+@dataclass(frozen=True)
+class NodeAffinityScheduling(SchedulingStrategy):
+    node_id: bytes
+    soft: bool = False
+
+
+@dataclass(frozen=True)
+class PlacementGroupScheduling(SchedulingStrategy):
+    pg_id: bytes
+    bundle_index: int = -1  # -1 = any bundle
+    capture_child_tasks: bool = False
+
+
+@dataclass(frozen=True)
+class NodeLabelScheduling(SchedulingStrategy):
+    hard: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
+    soft: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
+
+
+class TaskKind(enum.Enum):
+    NORMAL = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class TaskOptions:
+    """Normalized ``.options(...)``/``@remote(...)`` arguments."""
+
+    num_cpus: Optional[float] = None
+    num_tpus: Optional[float] = None
+    resources: Dict[str, float] = field(default_factory=dict)
+    memory: Optional[float] = None
+    num_returns: Any = None  # int | "dynamic" | "streaming"
+    max_retries: Optional[int] = None
+    retry_exceptions: Any = False  # bool | list of exception types
+    name: Optional[str] = None
+    scheduling_strategy: SchedulingStrategy = field(default_factory=DefaultScheduling)
+    runtime_env: Optional[Dict[str, Any]] = None
+    # actor-only
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: Optional[int] = None
+    max_pending_calls: int = -1
+    lifetime: Optional[str] = None  # None | "detached"
+    namespace: Optional[str] = None
+    get_if_exists: bool = False
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
+
+    def resource_request(self, default_cpus: float = 1.0) -> ResourceSet:
+        req: Dict[str, float] = dict(self.resources)
+        cpus = self.num_cpus if self.num_cpus is not None else default_cpus
+        if cpus:
+            req["CPU"] = req.get("CPU", 0) + cpus
+        if self.num_tpus:
+            req["TPU"] = req.get("TPU", 0) + self.num_tpus
+        if self.memory:
+            req["memory"] = req.get("memory", 0) + self.memory
+        return ResourceSet(req)
+
+    def merged_with(self, **updates) -> "TaskOptions":
+        import copy
+
+        out = copy.copy(self)
+        out.resources = dict(self.resources)
+        out.concurrency_groups = dict(self.concurrency_groups)
+        for k, v in updates.items():
+            if v is None and k not in ("num_returns",):
+                continue
+            if not hasattr(out, k):
+                raise TypeError(f"unknown option: {k}")
+            setattr(out, k, v)
+        return out
+
+
+@dataclass
+class TaskSpec:
+    """One invocation: a normal task, actor creation, or actor method call."""
+
+    kind: TaskKind
+    task_id: TaskID
+    job_id: JobID
+    name: str
+    function_id: bytes  # key into the exported-function KV
+    # Serialized positional/keyword args. Each entry is either
+    # ("ref", ObjectRef) or ("val", bytes) — small args inline (reference
+    # DependencyResolver inlining, ``normal_task_submitter.h``).
+    args: List[Tuple[str, Any]] = field(default_factory=list)
+    kwargs: List[Tuple[str, str, Any]] = field(default_factory=list)
+    num_returns: Any = 1
+    return_ids: List[ObjectID] = field(default_factory=list)
+    resources: Dict[str, float] = field(default_factory=dict)
+    scheduling_strategy: SchedulingStrategy = field(default_factory=DefaultScheduling)
+    owner: Optional[Address] = None
+    max_retries: int = 0
+    retry_exceptions: Any = False
+    runtime_env: Optional[Dict[str, Any]] = None
+    # actor creation
+    actor_id: Optional[ActorID] = None
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
+    actor_name: Optional[str] = None
+    namespace: Optional[str] = None
+    lifetime: Optional[str] = None
+    method_opts: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    # actor task
+    method_name: Optional[str] = None
+    seq_no: int = 0
+    concurrency_group: Optional[str] = None
+
+    def dependencies(self) -> List[ObjectRef]:
+        deps = [a for t, a in self.args if t == "ref"]
+        deps += [v for t, _k, v in self.kwargs if t == "ref"]
+        return deps

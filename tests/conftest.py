@@ -1,0 +1,42 @@
+"""Test fixtures.
+
+Mirrors the reference's load-bearing fixtures
+(``python/ray/tests/conftest.py``): ``ray_start_local`` (eager in-process),
+``ray_start_regular`` (real single-node runtime), and the simulated
+multi-node ``cluster`` fixture (``python/ray/cluster_utils.py:135``).
+
+JAX-dependent tests run on a virtual 8-device CPU mesh: the env vars below
+must be set before jax initializes, which this conftest guarantees because
+pytest imports it before any test module.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_local():
+    ray_tpu.init(local_mode=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    yield
+    ray_tpu.shutdown()

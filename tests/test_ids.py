@@ -1,0 +1,44 @@
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+
+
+def test_id_sizes_and_lineage_embedding():
+    job = JobID.from_index(7)
+    actor = ActorID.of(job)
+    task = TaskID.for_task(actor)
+    obj = ObjectID.from_index(task, 3)
+
+    assert len(job.binary()) == 4
+    assert len(actor.binary()) == 12
+    assert len(task.binary()) == 20
+    assert len(obj.binary()) == 24
+
+    # lineage: each larger id embeds the smaller
+    assert actor.job_id() == job
+    assert task.actor_id() == actor
+    assert obj.task_id() == task
+    assert obj.index() == 3
+    assert obj.job_id() == job
+
+
+def test_put_ids_do_not_collide_with_returns():
+    job = JobID.from_random()
+    task = TaskID.for_driver(job)
+    ret = ObjectID.from_index(task, 1)
+    put = ObjectID.for_put(task, 1)
+    assert ret != put
+    assert put.is_put() and not ret.is_put()
+
+
+def test_id_equality_hash_pickle():
+    import pickle
+
+    a = TaskID.for_task(ActorID.of(JobID.from_index(1)))
+    b = TaskID(a.binary())
+    assert a == b and hash(a) == hash(b)
+    assert pickle.loads(pickle.dumps(a)) == a
+    assert a != TaskID.for_task(ActorID.of(JobID.from_index(1)))
+
+
+def test_nil():
+    assert JobID.nil().is_nil()
+    assert not JobID.from_index(1).is_nil()

@@ -1,0 +1,169 @@
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import ActorDiedError, TaskError
+
+
+def test_task_roundtrip(ray_start_local):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_put_get(ray_start_local):
+    arr = np.ones((10, 10))
+    ref = ray_tpu.put(arr)
+    np.testing.assert_array_equal(ray_tpu.get(ref), arr)
+
+
+def test_ref_as_arg(ray_start_local):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    ref = ray_tpu.put(21)
+    assert ray_tpu.get(double.remote(ref)) == 42
+
+
+def test_chained_tasks(ray_start_local):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(9):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 10
+
+
+def test_multiple_returns(ray_start_local):
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    a, b = two.remote()
+    assert ray_tpu.get(a) == 1 and ray_tpu.get(b) == 2
+
+
+def test_task_error_propagates(ray_start_local):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    @ray_tpu.remote
+    def dep(x):
+        return x
+
+    ref = boom.remote()
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(ref)
+    assert "kaboom" in str(ei.value)
+    # errors flow through dependents
+    with pytest.raises(TaskError):
+        ray_tpu.get(dep.remote(ref))
+
+
+def test_options_override(ray_start_local):
+    @ray_tpu.remote
+    def f():
+        return "ok"
+
+    assert ray_tpu.get(f.options(num_cpus=2, name="custom").remote()) == "ok"
+
+
+def test_wait(ray_start_local):
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(4)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=2)
+    assert len(ready) == 2 and len(not_ready) == 2
+
+
+def test_actor_basic(ray_start_local):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote()) == 11
+    assert ray_tpu.get(c.incr.remote(5)) == 16
+
+
+def test_actor_kill(ray_start_local):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    ray_tpu.kill(a)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.ping.remote())
+
+
+def test_named_actor(ray_start_local):
+    @ray_tpu.remote
+    class A:
+        def who(self):
+            return "named"
+
+    A.options(name="singleton").remote()
+    h = ray_tpu.get_actor("singleton")
+    assert ray_tpu.get(h.who.remote()) == "named"
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("missing")
+
+
+def test_get_if_exists(ray_start_local):
+    @ray_tpu.remote
+    class A:
+        def pid(self):
+            return id(self)
+
+    a1 = A.options(name="gie", get_if_exists=True).remote()
+    a2 = A.options(name="gie", get_if_exists=True).remote()
+    assert ray_tpu.get(a1.pid.remote()) == ray_tpu.get(a2.pid.remote())
+
+
+def test_actor_method_decorator(ray_start_local):
+    @ray_tpu.remote
+    class A:
+        @ray_tpu.method(num_returns=2)
+        def two(self):
+            return 1, 2
+
+    a = A.remote()
+    r1, r2 = a.two.remote()
+    assert ray_tpu.get([r1, r2]) == [1, 2]
+
+
+def test_cannot_call_remote_directly(ray_start_local):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_nested_refs_in_value(ray_start_local):
+    inner = ray_tpu.put(5)
+    outer = ray_tpu.put({"ref": inner})
+    out = ray_tpu.get(outer)
+    assert ray_tpu.get(out["ref"]) == 5
+
+
+def test_cluster_resources(ray_start_local):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] > 0

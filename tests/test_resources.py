@@ -1,0 +1,39 @@
+import pytest
+
+from ray_tpu.core.resources import NodeResources, ResourceSet, pg_resource_name, tpu_slice_head_resource
+
+
+def test_fixed_point_no_drift():
+    r = ResourceSet({"CPU": 0.1})
+    total = ResourceSet({})
+    for _ in range(10):
+        total = total.add(r)
+    assert total.get("CPU") == 1.0
+
+
+def test_covers_and_subtract():
+    node = ResourceSet({"CPU": 4, "TPU": 8})
+    req = ResourceSet({"CPU": 1, "TPU": 4})
+    assert node.covers(req)
+    rem = node.subtract(req)
+    assert rem.get("CPU") == 3 and rem.get("TPU") == 4
+    with pytest.raises(ValueError):
+        rem.subtract(ResourceSet({"TPU": 5}))
+
+
+def test_node_resources_alloc_release_utilization():
+    node = NodeResources(ResourceSet({"CPU": 4, "TPU": 4}))
+    req = ResourceSet({"CPU": 2})
+    assert node.can_fit(req)
+    node.allocate(req)
+    assert node.available.get("CPU") == 2
+    assert node.utilization() == 0.5
+    node.release(req)
+    assert node.available.get("CPU") == 4
+    assert node.utilization() == 0
+
+
+def test_pg_shadow_resource_names():
+    assert pg_resource_name("CPU", "abcd") == "CPU_group_abcd"
+    assert pg_resource_name("TPU", "abcd", 2) == "TPU_group_2_abcd"
+    assert tpu_slice_head_resource("v5e-8") == "TPU-v5e-8-head"

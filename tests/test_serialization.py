@@ -1,0 +1,52 @@
+import numpy as np
+import pytest
+
+from ray_tpu.core import serialization
+
+
+def roundtrip(value):
+    ser = serialization.serialize(value)
+    return serialization.deserialize_bytes(ser.to_bytes())
+
+
+def test_basic_types():
+    for v in [1, "x", None, True, [1, 2, {"a": (3, 4)}], {"k": b"bytes"}]:
+        assert roundtrip(v) == v
+
+
+def test_numpy_zero_copy_out_of_band():
+    arr = np.arange(1000, dtype=np.float32)
+    ser = serialization.serialize(arr)
+    # array data must travel out-of-band, not inside the pickle meta
+    assert len(ser.buffers) >= 1
+    assert len(ser.meta) < 500
+    out = roundtrip(arr)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_closure():
+    x = 41
+
+    def f(y):
+        return x + y
+
+    assert roundtrip(f)(1) == 42
+
+
+def test_custom_serializer():
+    class Weird:
+        def __init__(self, v):
+            self.v = v
+
+        def __reduce__(self):
+            raise RuntimeError("not picklable")
+
+    serialization.register_serializer(
+        Weird, serializer=lambda w: w.v, deserializer=lambda v: Weird(v)
+    )
+    try:
+        assert roundtrip(Weird(5)).v == 5
+    finally:
+        serialization.deregister_serializer(Weird)
+    with pytest.raises(Exception):
+        roundtrip(Weird(5))
