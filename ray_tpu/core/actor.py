@@ -112,6 +112,7 @@ class ActorHandle:
         spec.concurrency_group = opts.get("concurrency_group")
         worker.backend.submit_actor_task(spec)
         refs = [ObjectRef(oid, worker.address) for oid in spec.return_ids]
+        worker.backend.release_hold(spec.return_ids)
         if spec.num_returns == 0:
             return None
         return refs[0] if spec.num_returns == 1 else refs

@@ -77,10 +77,17 @@ class RuntimeBackend(ABC):
     def free(self, object_ids: Sequence[ObjectID]) -> None: ...
 
     @abstractmethod
-    def add_local_ref(self, object_id: ObjectID) -> None: ...
+    def add_local_ref(self, ref: ObjectRef) -> None: ...
 
     @abstractmethod
-    def remove_local_ref(self, object_id: ObjectID) -> None: ...
+    def remove_local_ref(self, ref: ObjectRef) -> None: ...
+
+    def register_borrow(self, ref: ObjectRef) -> None:
+        """A ref was deserialized into this process (borrower protocol)."""
+        self.add_local_ref(ref)
+
+    def release_hold(self, object_ids: Sequence[ObjectID]) -> None:
+        """Release the submission hold after real ObjectRefs exist."""
 
     @abstractmethod
     def cluster_resources(self) -> Dict[str, float]: ...
@@ -132,19 +139,19 @@ class Worker:
     # ---- refcounting hooks --------------------------------------------
     def _on_ref_created(self, ref: ObjectRef) -> None:
         try:
-            self.backend.add_local_ref(ref.id())
+            self.backend.add_local_ref(ref)
         except Exception:
             pass
 
     def _on_ref_deleted(self, ref: ObjectRef) -> None:
         try:
-            self.backend.remove_local_ref(ref.id())
+            self.backend.remove_local_ref(ref)
         except Exception:
             pass
 
     def _on_ref_borrowed(self, ref: ObjectRef) -> None:
         try:
-            self.backend.add_local_ref(ref.id())
+            self.backend.register_borrow(ref)
         except Exception:
             pass
 
@@ -158,7 +165,9 @@ class Worker:
         object_id = ObjectID.for_put(self.current_task_id, idx)
         ser = serialization.serialize(value)
         self.backend.put_object(object_id, ser)
-        return ObjectRef(object_id, self.address)
+        ref = ObjectRef(object_id, self.address)
+        self.backend.release_hold([object_id])
+        return ref
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -218,7 +227,9 @@ class Worker:
             idx = self._put_counter
         object_id = ObjectID.for_put(self.current_task_id, idx)
         self.backend.put_object(object_id, ser)
-        return ObjectRef(object_id, self.address)
+        ref = ObjectRef(object_id, self.address)
+        self.backend.release_hold([object_id])
+        return ref
 
     def new_task_id(self) -> TaskID:
         return TaskID.for_task(ActorID.nil_for_job(self.job_id))
@@ -280,6 +291,7 @@ class Worker:
         spec = self.make_task_spec(TaskKind.NORMAL, function_obj, name, args, kwargs, opts)
         self.backend.submit_task(spec)
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids]
+        self.backend.release_hold(spec.return_ids)
         if spec.num_returns == 0:
             return None
         if spec.num_returns == 1:

@@ -1,0 +1,147 @@
+"""Driver-side cluster backend: CoreWorker + cluster lifecycle.
+
+``ray_tpu.init()`` with no address spawns a head process (controller +
+head-node daemon, see ``head_main.py``) and connects to it;
+``ray_tpu.init(address=...)`` connects to an existing cluster started by
+the ``Cluster`` test fixture or the CLI. Address format:
+``host:controller_port:daemon_port``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+from ray_tpu.core.core_worker import CoreWorker
+
+
+def _subprocess_env() -> dict:
+    """Env for child processes: make the ray_tpu package importable even
+    when the driver found it via sys.path manipulation."""
+    import ray_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    env = dict(os.environ)
+    existing = env.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        env["PYTHONPATH"] = pkg_root + (os.pathsep + existing if existing else "")
+    return env
+
+
+class ClusterBackend(CoreWorker):
+    _head_proc: Optional[subprocess.Popen] = None
+
+    @classmethod
+    def start_cluster(
+        cls,
+        num_cpus: Optional[float] = None,
+        resources: Optional[Dict[str, float]] = None,
+        num_nodes: int = 1,
+    ) -> "ClusterBackend":
+        session_dir = f"/tmp/ray_tpu/session_{os.getpid()}_{int(time.time())}"
+        cmd = [sys.executable, "-m", "ray_tpu.core.head_main", "--session-dir", session_dir]
+        if num_cpus is not None:
+            cmd += ["--num-cpus", str(num_cpus)]
+        if resources:
+            cmd += ["--resources", json.dumps(resources)]
+        from ray_tpu.core.config import GLOBAL_CONFIG, serialize_config
+
+        cmd += ["--system-config", serialize_config()]
+        os.makedirs(session_dir, exist_ok=True)
+        err_f = open(os.path.join(session_dir, "head.log"), "ab")
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=err_f, start_new_session=True,
+            env=_subprocess_env(),
+        )
+        line = proc.stdout.readline().decode()
+        if not line:
+            raise RuntimeError(f"head process failed to start (see {session_dir}/head.log)")
+        ports = json.loads(line)
+        backend = cls(
+            "127.0.0.1", ports["controller_port"], "127.0.0.1", ports["daemon_port"]
+        )
+        backend._head_proc = proc
+        backend._finish_handshake()
+        # extra simulated nodes (tests / local multi-node)
+        backend._extra_nodes = []
+        for _ in range(max(0, num_nodes - 1)):
+            backend._extra_nodes.append(
+                spawn_node(
+                    f"127.0.0.1:{ports['controller_port']}", num_cpus=num_cpus, resources=resources
+                )
+            )
+        return backend
+
+    @classmethod
+    def connect(cls, address: str) -> "ClusterBackend":
+        host, cport, dport = address.rsplit(":", 2)
+        backend = cls(host, int(cport), host, int(dport))
+        backend._head_proc = None
+        backend._extra_nodes = []
+        backend._finish_handshake()
+        return backend
+
+    def _finish_handshake(self) -> None:
+        reply = self.io.run(self.daemon.call("hello", retries=5))
+        self.finish_init(reply["node_id"])
+
+    def bind_worker(self, worker) -> None:
+        worker.address = self.address
+        self.io.run(
+            self.controller.call(
+                "register_job", {"job_id": worker.job_id.binary(), "driver_pid": os.getpid()}
+            )
+        )
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        for proc in getattr(self, "_extra_nodes", []):
+            _stop(proc)
+        if self._head_proc is not None:
+            _stop(self._head_proc)
+
+
+def spawn_node(
+    controller_addr: str,
+    num_cpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "ray_tpu.core.node_main", "--controller", controller_addr]
+    if num_cpus is not None:
+        cmd += ["--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    if labels:
+        cmd += ["--labels", json.dumps(labels)]
+    from ray_tpu.core.config import serialize_config
+
+    cmd += ["--system-config", serialize_config()]
+    os.makedirs("/tmp/ray_tpu", exist_ok=True)
+    err_f = open(f"/tmp/ray_tpu/node-{os.getpid()}-{time.time_ns()}.log", "ab")
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=err_f, start_new_session=True,
+        env=_subprocess_env(),
+    )
+    line = proc.stdout.readline().decode()
+    if not line:
+        raise RuntimeError("node daemon failed to start")
+    info = json.loads(line)
+    proc.node_port = info["daemon_port"]  # type: ignore[attr-defined]
+    proc.node_id_hex = info["node_id"]  # type: ignore[attr-defined]
+    return proc
+
+
+def _stop(proc: subprocess.Popen) -> None:
+    try:
+        proc.terminate()
+        proc.wait(timeout=5)
+    except Exception:
+        try:
+            proc.kill()
+        except Exception:
+            pass

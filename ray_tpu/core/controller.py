@@ -1,0 +1,515 @@
+"""The cluster control plane (GCS equivalent).
+
+Reference: ``src/ray/gcs/gcs_server/`` — a single authority process holding
+node membership + health (``GcsNodeManager``, ``GcsHealthCheckManager``),
+the actor FSM with restarts (``GcsActorManager``, ``gcs_actor_manager.h:308``,
+restart at ``:548``), GCS-side actor scheduling
+(``GcsActorScheduler::ScheduleByGcs``), placement groups with 2PC bundle
+reservation (``GcsPlacementGroupManager``), namespaced KV
+(``GcsKvManager``), and pubsub fan-out of state changes.
+
+This implementation is the asyncio redesign: one event loop, plain dict
+tables (Redis-style persistence is a pluggable later step), RPC service
+methods named ``c_*``, push-based subscriptions for actor/node state.
+Resource views arrive by periodic daemon sync (ray_syncer pattern,
+``ray_syncer/ray_syncer.h:88``) and the same sync reply carries the cluster
+view back to daemons for spillback scheduling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.ids import ActorID, NodeID, PlacementGroupID
+from ray_tpu.core.refs import Address
+from ray_tpu.core.rpc import RpcClient, RpcServer, ServerConnection
+from ray_tpu.core.scheduling_policies import (
+    BundleReservation,
+    pick_node_hybrid,
+    place_bundles,
+)
+from ray_tpu.core.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+ACTOR_PUSH_CHANNEL = 1
+NODE_PUSH_CHANNEL = 2
+PG_PUSH_CHANNEL = 3
+
+
+@dataclass
+class NodeInfo:
+    node_id: bytes
+    host: str
+    port: int
+    total: Dict[str, float]
+    available: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_sync: float = field(default_factory=time.monotonic)
+    health_failures: int = 0
+
+
+@dataclass
+class ActorInfo:
+    spec: TaskSpec
+    state: str = "PENDING"  # PENDING|ALIVE|RESTARTING|DEAD
+    address: Optional[Address] = None
+    node_id: Optional[bytes] = None
+    num_restarts: int = 0
+    death_reason: str = ""
+    pid: int = 0
+
+
+@dataclass
+class PgInfo:
+    pg_id: bytes
+    bundles: List[Dict[str, float]]
+    strategy: str
+    state: str = "PENDING"  # PENDING|CREATED|REMOVED
+    reservations: List[BundleReservation] = field(default_factory=list)
+    name: str = ""
+
+
+class Controller:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = RpcServer(host, port)
+        self.nodes: Dict[bytes, NodeInfo] = {}
+        self.node_clients: Dict[bytes, RpcClient] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.pgs: Dict[bytes, PgInfo] = {}
+        self.named_pgs: Dict[str, bytes] = {}
+        self.kv: Dict[bytes, bytes] = {}
+        self.jobs: Dict[bytes, Dict[str, Any]] = {}
+        self._subscribers: Set[ServerConnection] = set()
+        self._health_task: Optional[asyncio.Task] = None
+        self._stopping = False
+        for name in [m for m in dir(self) if m.startswith("c_")]:
+            self.server.register(name[2:], getattr(self, name))
+        self.server.on_disconnect = self._on_disconnect
+
+    async def start(self) -> int:
+        port = await self.server.start()
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        return port
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._health_task:
+            self._health_task.cancel()
+        for c in self.node_clients.values():
+            await c.close()
+        await self.server.stop()
+
+    def _on_disconnect(self, conn: ServerConnection) -> None:
+        self._subscribers.discard(conn)
+
+    # ---- pubsub --------------------------------------------------------
+    async def _publish(self, channel: int, payload: Any) -> None:
+        dead = []
+        for conn in list(self._subscribers):
+            try:
+                await conn.push(channel, payload)
+            except Exception:
+                dead.append(conn)
+        for conn in dead:
+            self._subscribers.discard(conn)
+
+    async def c_subscribe(self, payload, conn: ServerConnection):
+        """Subscribe this connection to actor/node/pg pushes."""
+        self._subscribers.add(conn)
+        return True
+
+    # ---- nodes & resource sync ----------------------------------------
+    async def c_register_node(self, payload, conn):
+        info = NodeInfo(
+            node_id=payload["node_id"],
+            host=payload["host"],
+            port=payload["port"],
+            total=payload["resources"],
+            available=dict(payload["resources"]),
+            labels=payload.get("labels", {}),
+        )
+        self.nodes[info.node_id] = info
+        self.node_clients[info.node_id] = RpcClient(info.host, info.port, name="noded")
+        logger.info("node %s registered (%s)", info.node_id.hex()[:8], info.total)
+        await self._publish(NODE_PUSH_CHANNEL, {"node_id": info.node_id, "alive": True})
+        return {"ok": True}
+
+    async def c_sync_resources(self, payload, conn):
+        """Daemon heartbeat: report availability, receive the cluster view
+        (the ray_syncer exchange)."""
+        node = self.nodes.get(payload["node_id"])
+        if node is not None:
+            node.available = payload["available"]
+            node.total = payload.get("total", node.total)
+            node.last_sync = time.monotonic()
+            node.health_failures = 0
+        return {
+            "view": [
+                {
+                    "node_id": n.node_id,
+                    "host": n.host,
+                    "port": n.port,
+                    "total": n.total,
+                    "available": n.available,
+                    "alive": n.alive,
+                    "labels": n.labels,
+                }
+                for n in self.nodes.values()
+                if n.alive
+            ]
+        }
+
+    async def c_nodes(self, payload, conn):
+        return [
+            {
+                "NodeID": n.node_id.hex(),
+                "node_id": n.node_id,
+                "Alive": n.alive,
+                "Resources": n.total,
+                "Available": n.available,
+                "host": n.host,
+                "port": n.port,
+                "Labels": n.labels,
+            }
+            for n in self.nodes.values()
+        ]
+
+    async def c_cluster_resources(self, payload, conn):
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.total.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    async def c_available_resources(self, payload, conn):
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.available.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    async def _health_loop(self) -> None:
+        """Daemon health via resource-sync staleness (the syncer heartbeats
+        every ~200ms) plus an active ping with a short connect timeout
+        (``gcs_health_check_manager.h:39``)."""
+        period = GLOBAL_CONFIG.health_check_period_s
+        threshold = GLOBAL_CONFIG.health_check_failure_threshold
+        while not self._stopping:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if not node.alive:
+                    continue
+                if now - node.last_sync <= period * threshold:
+                    node.health_failures = 0
+                    continue
+                client = self.node_clients.get(node.node_id)
+                try:
+                    await client.call("ping", timeout=period, connect_timeout=period)
+                    node.health_failures = 0
+                except Exception:
+                    # stale sync + N consecutive failed pings → dead
+                    node.health_failures += 1
+                    if node.health_failures >= threshold:
+                        await self._mark_node_dead(node, "health check failed")
+
+    async def _mark_node_dead(self, node: NodeInfo, reason: str) -> None:
+        if not node.alive:
+            return
+        node.alive = False
+        logger.warning("node %s dead: %s", node.node_id.hex()[:8], reason)
+        await self._publish(NODE_PUSH_CHANNEL, {"node_id": node.node_id, "alive": False})
+        # Fail over actors that lived there.
+        for actor_id, info in list(self.actors.items()):
+            if info.node_id == node.node_id and info.state in ("ALIVE", "PENDING", "RESTARTING"):
+                await self._handle_actor_death(actor_id, f"node died: {reason}")
+
+    async def c_drain_node(self, payload, conn):
+        node = self.nodes.get(payload["node_id"])
+        if node is not None:
+            await self._mark_node_dead(node, "drained")
+        return True
+
+    # ---- actors --------------------------------------------------------
+    async def c_register_actor(self, payload, conn):
+        spec: TaskSpec = payload["spec"]
+        info = ActorInfo(spec=spec)
+        self.actors[spec.actor_id] = info
+        if spec.actor_name:
+            key = (spec.namespace or "", spec.actor_name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing is not None and existing.state != "DEAD":
+                    del self.actors[spec.actor_id]
+                    raise ValueError(
+                        f"actor name {spec.actor_name!r} already taken in "
+                        f"namespace {spec.namespace!r}"
+                    )
+            self.named_actors[key] = spec.actor_id
+        asyncio.ensure_future(self._schedule_actor(spec.actor_id))
+        return {"ok": True}
+
+    async def _schedule_actor(self, actor_id: ActorID) -> None:
+        """GCS-direct actor scheduling (``GcsActorScheduler::ScheduleByGcs``)."""
+        info = self.actors.get(actor_id)
+        if info is None or info.state == "DEAD":
+            return
+        deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_s
+        while time.monotonic() < deadline:
+            node = pick_node_hybrid(
+                self._alive_nodes(), info.spec.resources, info.spec.scheduling_strategy, self.pgs
+            )
+            if node is not None:
+                client = self.node_clients[node.node_id]
+                try:
+                    result = await client.call(
+                        "start_actor", {"spec": info.spec}, timeout=60
+                    )
+                    info.node_id = node.node_id
+                    info.pid = result.get("pid", 0)
+                    return  # worker will call actor_ready / actor_failed
+                except Exception as e:
+                    logger.warning("start_actor on %s failed: %r", node.node_id.hex()[:8], e)
+            await asyncio.sleep(0.1)
+        await self._finalize_actor_death(
+            actor_id, f"no node can host actor (needs {info.spec.resources})"
+        )
+
+    def _alive_nodes(self) -> List[NodeInfo]:
+        return [n for n in self.nodes.values() if n.alive]
+
+    async def c_actor_ready(self, payload, conn):
+        info = self.actors.get(payload["actor_id"])
+        if info is None:
+            return {"ok": False}
+        info.address = payload["address"]
+        info.state = "ALIVE"
+        await self._publish(
+            ACTOR_PUSH_CHANNEL,
+            {"actor_id": payload["actor_id"], "state": "ALIVE", "address": info.address},
+        )
+        return {"ok": True}
+
+    async def c_actor_creation_failed(self, payload, conn):
+        await self._finalize_actor_death(
+            payload["actor_id"], payload.get("reason", "creation failed"), creation_error=payload.get("error")
+        )
+        return {"ok": True}
+
+    async def c_report_actor_death(self, payload, conn):
+        await self._handle_actor_death(payload["actor_id"], payload.get("reason", "worker died"))
+        return {"ok": True}
+
+    async def _handle_actor_death(self, actor_id: ActorID, reason: str) -> None:
+        """The actor FSM restart edge (``gcs_actor_manager.h:548``)."""
+        info = self.actors.get(actor_id)
+        if info is None or info.state == "DEAD":
+            return
+        if info.num_restarts < info.spec.max_restarts and not self._stopping:
+            info.num_restarts += 1
+            info.state = "RESTARTING"
+            info.address = None
+            await self._publish(
+                ACTOR_PUSH_CHANNEL, {"actor_id": actor_id, "state": "RESTARTING"}
+            )
+            logger.info(
+                "restarting actor %s (%d/%d): %s",
+                actor_id.hex()[:8], info.num_restarts, info.spec.max_restarts, reason,
+            )
+            asyncio.ensure_future(self._schedule_actor(actor_id))
+        else:
+            await self._finalize_actor_death(actor_id, reason)
+
+    async def _finalize_actor_death(self, actor_id: ActorID, reason: str, creation_error=None) -> None:
+        info = self.actors.get(actor_id)
+        if info is None:
+            return
+        info.state = "DEAD"
+        info.death_reason = reason
+        await self._publish(
+            ACTOR_PUSH_CHANNEL,
+            {"actor_id": actor_id, "state": "DEAD", "reason": reason, "error": creation_error},
+        )
+
+    async def c_kill_actor(self, payload, conn):
+        actor_id = payload["actor_id"]
+        info = self.actors.get(actor_id)
+        if info is None:
+            return {"ok": False}
+        if payload.get("no_restart", True):
+            info.spec.max_restarts = 0
+        if info.address is not None and info.node_id in self.node_clients:
+            try:
+                await self.node_clients[info.node_id].call(
+                    "kill_worker", {"pid": info.pid, "actor_id": actor_id}, timeout=5
+                )
+            except Exception:
+                pass
+        await self._handle_actor_death(actor_id, "killed via kill()")
+        return {"ok": True}
+
+    async def c_get_actor_info(self, payload, conn):
+        info = self.actors.get(payload["actor_id"])
+        if info is None:
+            return None
+        return {
+            "state": info.state,
+            "address": info.address,
+            "reason": info.death_reason,
+            "num_restarts": info.num_restarts,
+            "max_concurrency": info.spec.max_concurrency,
+            "max_task_retries": info.spec.max_task_retries,
+        }
+
+    async def c_get_named_actor(self, payload, conn):
+        key = (payload.get("namespace") or "", payload["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            return None
+        info = self.actors.get(actor_id)
+        if info is None or info.state == "DEAD":
+            return None
+        return {
+            "actor_id": actor_id,
+            "method_opts": info.spec.method_opts,
+            "owner": info.spec.owner,
+        }
+
+    async def c_list_named_actors(self, payload, conn):
+        out = []
+        for (ns, name), actor_id in self.named_actors.items():
+            info = self.actors.get(actor_id)
+            if info is None or info.state == "DEAD":
+                continue
+            if payload.get("all_namespaces") or ns == (payload.get("namespace") or ""):
+                out.append({"name": name, "namespace": ns})
+        return out
+
+    # ---- placement groups ---------------------------------------------
+    async def c_create_pg(self, payload, conn):
+        pg_id: bytes = payload["pg_id"]
+        info = PgInfo(
+            pg_id=pg_id,
+            bundles=payload["bundles"],
+            strategy=payload["strategy"],
+            name=payload.get("name", ""),
+        )
+        self.pgs[pg_id] = info
+        if info.name:
+            self.named_pgs[info.name] = pg_id
+        asyncio.ensure_future(self._schedule_pg(pg_id))
+        return {"ok": True}
+
+    async def _schedule_pg(self, pg_id: bytes) -> None:
+        """Bundle placement + 2-phase commit with the daemons
+        (``GcsPlacementGroupScheduler`` + PACK/SPREAD/STRICT_* policies)."""
+        info = self.pgs.get(pg_id)
+        if info is None:
+            return
+        deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_s
+        while time.monotonic() < deadline and not self._stopping:
+            plan = place_bundles(self._alive_nodes(), info.bundles, info.strategy)
+            if plan is not None:
+                # phase 1: prepare on every node
+                prepared: List[BundleReservation] = []
+                ok = True
+                for res in plan:
+                    try:
+                        await self.node_clients[res.node_id].call(
+                            "prepare_bundle",
+                            {"pg_id": pg_id, "bundle_index": res.bundle_index, "resources": res.resources},
+                            timeout=10,
+                        )
+                        prepared.append(res)
+                    except Exception as e:
+                        logger.warning("prepare_bundle failed: %r", e)
+                        ok = False
+                        break
+                if ok:
+                    # phase 2: commit everywhere
+                    for res in plan:
+                        await self.node_clients[res.node_id].call(
+                            "commit_bundle",
+                            {"pg_id": pg_id, "bundle_index": res.bundle_index, "resources": res.resources},
+                            timeout=10,
+                        )
+                    info.reservations = plan
+                    info.state = "CREATED"
+                    await self._publish(PG_PUSH_CHANNEL, {"pg_id": pg_id, "state": "CREATED"})
+                    return
+                for res in prepared:  # rollback
+                    try:
+                        await self.node_clients[res.node_id].call(
+                            "release_bundle", {"pg_id": pg_id, "bundle_index": res.bundle_index}, timeout=10
+                        )
+                    except Exception:
+                        pass
+            await asyncio.sleep(0.2)
+        info.state = "INFEASIBLE"
+        await self._publish(PG_PUSH_CHANNEL, {"pg_id": pg_id, "state": "INFEASIBLE"})
+
+    async def c_remove_pg(self, payload, conn):
+        pg_id = payload["pg_id"]
+        info = self.pgs.get(pg_id)
+        if info is None:
+            return {"ok": False}
+        for res in info.reservations:
+            client = self.node_clients.get(res.node_id)
+            if client is not None:
+                try:
+                    await client.call(
+                        "release_bundle", {"pg_id": pg_id, "bundle_index": res.bundle_index}, timeout=10
+                    )
+                except Exception:
+                    pass
+        info.state = "REMOVED"
+        if info.name:
+            self.named_pgs.pop(info.name, None)
+        return {"ok": True}
+
+    async def c_get_pg(self, payload, conn):
+        info = self.pgs.get(payload["pg_id"])
+        if info is None:
+            return None
+        return {
+            "state": info.state,
+            "bundles": info.bundles,
+            "strategy": info.strategy,
+            "nodes": [r.node_id for r in info.reservations],
+            "bundle_indices": [r.bundle_index for r in info.reservations],
+        }
+
+    # ---- kv ------------------------------------------------------------
+    async def c_kv_put(self, payload, conn):
+        self.kv[payload["key"]] = payload["value"]
+        return True
+
+    async def c_kv_get(self, payload, conn):
+        return self.kv.get(payload["key"])
+
+    async def c_kv_del(self, payload, conn):
+        return self.kv.pop(payload["key"], None) is not None
+
+    async def c_kv_keys(self, payload, conn):
+        prefix = payload.get("prefix", b"")
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    # ---- jobs ----------------------------------------------------------
+    async def c_register_job(self, payload, conn):
+        self.jobs[payload["job_id"]] = {"start_time": time.time(), **payload}
+        return True
+
+    async def c_ping(self, payload, conn):
+        return "pong"

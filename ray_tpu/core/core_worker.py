@@ -1,0 +1,770 @@
+"""CoreWorker: the per-process worker library for cluster mode.
+
+Reference: ``src/ray/core_worker/`` — the library linked into every worker
+and driver (``core_worker.h:163``): object put/get/wait against the dual
+store (in-process memory store + node shm store), normal-task submission
+through the raylet lease protocol with spillback
+(``transport/normal_task_submitter.h:108``), per-actor ordered submission
+with restart handling (``transport/actor_task_submitter``), the execution
+callback path (``HandlePushTask``, ``core_worker.cc:3617``), and the
+owner services (object status, borrower registration) backing the
+ownership model.
+
+One CoreWorker instance implements ``RuntimeBackend``, so drivers and
+workers share every code path; workers additionally run a ``TaskExecutor``
+(see ``task_executor.py``) behind their ``push_task`` service.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.api import RuntimeBackend
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.controller import ACTOR_PUSH_CHANNEL, NODE_PUSH_CHANNEL, PG_PUSH_CHANNEL
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    OwnerDiedError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, WorkerID
+from ray_tpu.core.object_store import MemoryStore, StoreClient
+from ray_tpu.core.ownership import ObjState, ReferenceCounter
+from ray_tpu.core.refs import Address, ObjectRef
+from ray_tpu.core.rpc import ConnectionLost, IoThread, RpcClient, RpcServer
+from ray_tpu.core.task_spec import TaskKind, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+class _ActorState:
+    def __init__(self):
+        self.state: str = "PENDING"
+        self.address: Optional[Address] = None
+        self.reason: str = ""
+        self.max_task_retries: int = 0
+        self.max_concurrency: int = 1
+        self.event = threading.Event()  # set whenever state changes
+
+
+class CoreWorker(RuntimeBackend):
+    def __init__(
+        self,
+        controller_host: str,
+        controller_port: int,
+        daemon_host: str,
+        daemon_port: int,
+        *,
+        io: Optional[IoThread] = None,
+        executor=None,  # TaskExecutor for worker processes
+    ):
+        self.io = io or IoThread()
+        self.executor = executor
+        self.worker_id = WorkerID.from_random()
+        self.memory = MemoryStore()
+        self.shm = StoreClient()
+        self.refcounter = ReferenceCounter(self._on_free)
+        self.node_id: bytes = b""
+        self.daemon_addr = (daemon_host, daemon_port)
+        self.address: Optional[Address] = None
+        self._actors: Dict[ActorID, _ActorState] = {}
+        self._actors_lock = threading.Lock()
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._pg_states: Dict[bytes, str] = {}
+        self._pg_events: Dict[bytes, threading.Event] = {}
+        self._actor_queues: Dict[ActorID, Any] = {}
+        self._pump_tasks: List[Any] = []
+        self._stopping = False
+
+        async def _setup():
+            self.server = RpcServer()
+            for name in [m for m in dir(self) if m.startswith("w_")]:
+                self.server.register(name[2:], getattr(self, name))
+            port = await self.server.start()
+            self.controller = RpcClient(controller_host, controller_port, name="controller")
+            self.daemon = RpcClient(daemon_host, daemon_port, name="noded")
+            self.controller.subscribe_push(ACTOR_PUSH_CHANNEL, self._on_actor_push)
+            self.controller.subscribe_push(PG_PUSH_CHANNEL, self._on_pg_push)
+            await self.controller.call("subscribe", retries=GLOBAL_CONFIG.rpc_max_retries)
+            return port
+
+        self.port = self.io.run(_setup())
+        self.host = "127.0.0.1"
+
+    def finish_init(self, node_id: bytes) -> None:
+        self.node_id = node_id
+        self.address = Address(
+            worker_id=self.worker_id.binary(),
+            node_id=node_id,
+            host=self.host,
+            port=self.port,
+        )
+
+    # ------------------------------------------------------------------
+    # client cache
+    def _client(self, host: str, port: int) -> RpcClient:
+        key = (host, port)
+        c = self._clients.get(key)
+        if c is None:
+            c = self._clients[key] = RpcClient(host, port, name=f"peer-{port}")
+        return c
+
+    def _owner_client(self, ref: ObjectRef) -> RpcClient:
+        addr = ref.owner_address
+        if addr is None:
+            raise OwnerDiedError(ref.id(), "ref has no owner address")
+        return self._client(addr.host, addr.port)
+
+    # ------------------------------------------------------------------
+    # objects: put
+    def put_object(self, object_id: ObjectID, ser: serialization.SerializedValue) -> None:
+        if ser.total_bytes <= GLOBAL_CONFIG.max_direct_call_object_size:
+            data = ser.to_bytes()
+            self.memory.put(object_id, data)
+            self.refcounter.create_inline(
+                object_id, data, contained=ser.contained_refs, hold=True
+            )
+        else:
+            size = self.shm.create_and_write(object_id, ser)
+            self.io.run(self.daemon.call("adopt_object", {"object_id": object_id.binary(), "size": size}))
+            self.refcounter.create_at_location(
+                object_id, self._self_location(), contained=ser.contained_refs, hold=True
+            )
+
+    def _self_location(self) -> tuple:
+        return (self.node_id, self.daemon_addr[0], self.daemon_addr[1])
+
+    # ------------------------------------------------------------------
+    # objects: get
+    def get_objects(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def _get_all():
+            return await asyncio.gather(*[self._get_one(r, deadline) for r in refs])
+
+        return self.io.run(_get_all())
+
+    async def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        oid = ref.id()
+        data = self.memory.get(oid)
+        if data is not None:
+            return serialization.deserialize_bytes(data)
+        if self.refcounter.owns(oid):
+            return await self._get_owned(ref, deadline)
+        return await self._get_borrowed(ref, deadline)
+
+    async def _get_owned(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        oid = ref.id()
+        loop = asyncio.get_event_loop()
+        timeout = None if deadline is None else max(0.0, deadline - time.monotonic())
+        obj = await loop.run_in_executor(None, self.refcounter.wait_ready, oid, timeout)
+        if obj is None or not obj.ready():
+            raise GetTimeoutError(f"get() timed out waiting for {oid.hex()[:12]}")
+        if obj.state == ObjState.FAILED:
+            return obj.error
+        if obj.inline is not None:
+            return serialization.deserialize_bytes(obj.inline)
+        return await self._fetch_from_locations(oid, list(obj.locations), deadline)
+
+    async def _get_borrowed(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        oid = ref.id()
+        owner = self._owner_client(ref)
+        while True:
+            step = 30.0
+            if deadline is not None:
+                step = min(step, max(0.0, deadline - time.monotonic()))
+            try:
+                status = await owner.call(
+                    "get_object_status",
+                    {"object_id": oid.binary(), "timeout": step},
+                    timeout=step + 10,
+                )
+            except ConnectionLost:
+                raise OwnerDiedError(oid, "owner process is gone")
+            kind = status["status"]
+            if kind == "inline":
+                data = status["data"]
+                self.memory.put(oid, data)  # borrower-side cache
+                return serialization.deserialize_bytes(data)
+            if kind == "locations":
+                return await self._fetch_from_locations(oid, status["locations"], deadline)
+            if kind == "error":
+                return pickle.loads(status["error"])
+            if kind == "unknown":
+                raise ObjectLostError(oid, "owner does not know this object (freed?)")
+            # pending → loop unless out of time
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(f"get() timed out waiting for {oid.hex()[:12]}")
+
+    async def _fetch_from_locations(self, oid: ObjectID, locations, deadline) -> Any:
+        """Materialize a shm object locally, then zero-copy deserialize."""
+        if not locations:
+            raise ObjectLostError(oid, "no locations")
+        local = next((l for l in locations if l[0] == self.node_id), None)
+        if local is not None:
+            meta = await self.daemon.call("get_object_meta", {"object_id": oid.binary()})
+        else:
+            meta = None
+        if meta is None:
+            sources = [(h, p) for (_nid, h, p) in locations if _nid != self.node_id]
+            meta = await self.daemon.call(
+                "pull_object", {"object_id": oid.binary(), "sources": sources}, timeout=300
+            )
+        if meta is None:
+            raise ObjectLostError(oid, f"could not fetch from {locations}")
+        buf = self.shm.read(oid, meta["size"])
+        value = serialization.deserialize_bytes(buf)
+        if self.refcounter.owns(oid):
+            self.refcounter.add_location(oid, self._self_location())
+        return value
+
+    # ------------------------------------------------------------------
+    # wait
+    def wait(self, refs, num_returns, timeout, fetch_local):
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def _poll():
+            while True:
+                ready, not_ready = [], []
+                for r in refs:
+                    if await self._is_ready(r):
+                        ready.append(r)
+                    else:
+                        not_ready.append(r)
+                if len(ready) >= num_returns or (
+                    deadline is not None and time.monotonic() >= deadline
+                ):
+                    return ready, not_ready
+                await asyncio.sleep(0.005)
+
+        ready, not_ready = self.io.run(_poll())
+        if len(ready) > num_returns:
+            not_ready = ready[num_returns:] + not_ready
+            ready = ready[:num_returns]
+        return ready, not_ready
+
+    async def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.id()
+        if self.memory.contains(oid):
+            return True
+        if self.refcounter.owns(oid):
+            obj = self.refcounter.get(oid)
+            return obj is not None and obj.ready()
+        try:
+            owner = self._owner_client(ref)
+            status = await owner.call(
+                "get_object_status", {"object_id": oid.binary(), "timeout": 0}, timeout=10
+            )
+            return status["status"] in ("inline", "locations", "error")
+        except Exception:
+            return True  # owner gone → get() will raise; count as "ready"
+
+    # ------------------------------------------------------------------
+    # free / refcounting
+    def _on_free(self, oid: ObjectID, obj) -> None:
+        self.memory.delete(oid)
+        self.shm.release(oid)
+        for loc in obj.locations:
+            _nid, host, port = loc
+            self.io.post(self._delete_remote(host, port, oid))
+
+    async def _delete_remote(self, host, port, oid):
+        try:
+            await self._client(host, port).call("delete_object", {"object_id": oid.binary()})
+        except Exception:
+            pass
+
+    def free(self, object_ids: Sequence[ObjectID]) -> None:
+        for oid in object_ids:
+            if self.refcounter.owns(oid):
+                self.refcounter.force_free(oid)
+            else:
+                self.memory.delete(oid)
+
+    def release_hold(self, object_ids) -> None:
+        for oid in object_ids:
+            self.refcounter.remove_local(oid)
+
+    def add_local_ref(self, ref: ObjectRef) -> None:
+        if self.refcounter.owns(ref.id()):
+            self.refcounter.add_local(ref.id())
+
+    def remove_local_ref(self, ref: ObjectRef) -> None:
+        if self._stopping:
+            return
+        if self.refcounter.owns(ref.id()):
+            self.refcounter.remove_local(ref.id())
+        elif ref.owner_address is not None:
+            self.io.post(self._send_borrow(ref, "remove_borrower"))
+
+    def register_borrow(self, ref: ObjectRef) -> None:
+        if self.refcounter.owns(ref.id()):
+            self.refcounter.add_local(ref.id())
+        elif ref.owner_address is not None:
+            self.io.post(self._send_borrow(ref, "add_borrower"))
+
+    async def _send_borrow(self, ref: ObjectRef, method: str) -> None:
+        try:
+            await self._owner_client(ref).call(method, {"object_id": ref.binary()})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # normal task submission (lease → push → results)
+    def submit_task(self, spec: TaskSpec) -> None:
+        for oid in spec.return_ids:
+            self.refcounter.create_pending(oid, lineage=spec, hold=True)
+        self._pin_deps(spec)
+        self.io.post(self._submit_normal(spec))
+
+    def _pin_deps(self, spec: TaskSpec) -> None:
+        for ref in spec.dependencies():
+            if self.refcounter.owns(ref.id()):
+                self.refcounter.add_submitted(ref.id())
+
+    def _unpin_deps(self, spec: TaskSpec) -> None:
+        for ref in spec.dependencies():
+            if self.refcounter.owns(ref.id()):
+                self.refcounter.remove_submitted(ref.id())
+
+    async def _submit_normal(self, spec: TaskSpec) -> None:
+        try:
+            await self._submit_normal_inner(spec)
+        except Exception as e:  # noqa: BLE001 — never leave returns pending
+            logger.exception("task %s submission failed", spec.name)
+            self._fail_returns(spec, e if isinstance(e, RayTpuError) else RayTpuError(repr(e)))
+
+    async def _submit_normal_inner(self, spec: TaskSpec) -> None:
+        retries_left = spec.max_retries
+        try:
+            while True:
+                try:
+                    grant = await self._acquire_lease(spec)
+                except RayTpuError as e:
+                    self._fail_returns(spec, e)
+                    return
+                logger.debug("task %s leased %s:%s", spec.name, grant["host"], grant["port"])
+                worker_client = self._client(grant["host"], grant["port"])
+                lease_daemon = self._client(grant["daemon_host"], grant["daemon_port"])
+                try:
+                    reply = await worker_client.call("push_task", {"spec": spec}, timeout=None, connect_timeout=3.0)
+                except ConnectionLost:
+                    if retries_left > 0:
+                        retries_left -= 1
+                        logger.info("task %s worker died; retrying", spec.name)
+                        continue
+                    self._fail_returns(
+                        spec, WorkerCrashedError(f"worker died executing {spec.name}")
+                    )
+                    return
+                finally:
+                    try:
+                        await lease_daemon.call("return_lease", {"lease_id": grant["lease_id"]})
+                    except Exception:
+                        pass
+                logger.debug("task %s reply received", spec.name)
+                retry = self._process_reply(spec, reply, retries_left)
+                if retry:
+                    retries_left -= 1
+                    continue
+                return
+        finally:
+            self._unpin_deps(spec)
+
+    async def _acquire_lease(self, spec: TaskSpec) -> Dict[str, Any]:
+        """Lease with spillback-following (reference lease protocol).
+
+        Placement-group leases go straight to a daemon holding one of the
+        PG's bundles (only those daemons have the bundle pools)."""
+        from ray_tpu.core.task_spec import PlacementGroupScheduling
+
+        daemon = self.daemon
+        daemon_addr = self.daemon_addr
+        if isinstance(spec.scheduling_strategy, PlacementGroupScheduling):
+            target = await self._pg_lease_target(spec.scheduling_strategy)
+            if target is not None:
+                daemon_addr = target
+                daemon = self._client(*target)
+        deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_s * 10
+        while True:
+            try:
+                reply = await daemon.call(
+                    "request_lease",
+                    {"resources": spec.resources, "strategy": spec.scheduling_strategy},
+                    timeout=60,
+                    connect_timeout=3.0,
+                )
+            except (ConnectionLost, asyncio.TimeoutError):
+                if daemon is self.daemon:
+                    raise RayTpuError("local node daemon unreachable")
+                # spillback target died — fall back to the local daemon
+                daemon, daemon_addr = self.daemon, self.daemon_addr
+                await asyncio.sleep(0.1)
+                continue
+            if "grant" in reply:
+                g = reply["grant"]
+                g["daemon_host"], g["daemon_port"] = daemon_addr
+                return g
+            if "spillback" in reply:
+                host, port = reply["spillback"]
+                daemon = self._client(host, port)
+                daemon_addr = (host, port)
+                continue
+            if reply.get("infeasible"):
+                raise RayTpuError(
+                    f"task {spec.name} requires {spec.resources} which no node can ever satisfy"
+                )
+            await asyncio.sleep(reply.get("retry_after", 0.05))
+            if isinstance(spec.scheduling_strategy, PlacementGroupScheduling):
+                target = await self._pg_lease_target(spec.scheduling_strategy)
+                if target is not None:
+                    daemon_addr = target
+                    daemon = self._client(*target)
+            else:
+                # fall back to local daemon (cluster may have changed)
+                daemon = self.daemon
+                daemon_addr = self.daemon_addr
+            if time.monotonic() > deadline:
+                raise RayTpuError(f"lease for {spec.name} timed out")
+
+    async def _pg_lease_target(self, strategy) -> Optional[Tuple[str, int]]:
+        """Daemon address of a node holding one of the PG's bundles."""
+        info = await self.controller.call("get_pg", {"pg_id": strategy.pg_id})
+        if not info or not info.get("nodes"):
+            return None
+        node_ids = info["nodes"]
+        indices = info.get("bundle_indices", list(range(len(node_ids))))
+        wanted = None
+        if strategy.bundle_index >= 0:
+            for nid, idx in zip(node_ids, indices):
+                if idx == strategy.bundle_index:
+                    wanted = nid
+                    break
+        else:
+            wanted = node_ids[0]
+        if wanted is None:
+            return None
+        for n in await self.controller.call("nodes"):
+            if n["node_id"] == wanted and n["Alive"]:
+                return (n["host"], n["port"])
+        return None
+
+    def _process_reply(self, spec: TaskSpec, reply: Dict[str, Any], retries_left: int) -> bool:
+        """Record results with the ownership table. Returns True if the
+        task should be retried (app-level error + retry_exceptions)."""
+        results: List[Tuple[bytes, str, Any]] = reply["results"]
+        # Check for retryable application errors first.
+        for _oid, kind, payload in results:
+            if kind == "error":
+                err = pickle.loads(payload)
+                if isinstance(err, TaskError) and self._should_retry_app_error(spec, err, retries_left):
+                    return True
+        for oid_bytes, kind, payload in results:
+            oid = ObjectID(oid_bytes)
+            if kind == "inline":
+                self.memory.put(oid, payload)
+                self.refcounter.mark_available_inline(oid, payload)
+            elif kind == "shm":
+                self.refcounter.mark_available_at(oid, tuple(payload))
+            elif kind == "error":
+                self.refcounter.mark_failed(oid, pickle.loads(payload))
+        return False
+
+    def _should_retry_app_error(self, spec: TaskSpec, err: TaskError, retries_left: int) -> bool:
+        if retries_left <= 0 or not spec.retry_exceptions:
+            return False
+        if spec.retry_exceptions is True:
+            return True
+        try:
+            return isinstance(err.cause, tuple(spec.retry_exceptions))
+        except TypeError:
+            return False
+
+    def _fail_returns(self, spec: TaskSpec, error: Exception) -> None:
+        for oid in spec.return_ids:
+            self.refcounter.mark_failed(oid, error)
+
+    # ------------------------------------------------------------------
+    # actors
+    def create_actor(self, spec: TaskSpec) -> None:
+        with self._actors_lock:
+            st = self._actors.setdefault(spec.actor_id, _ActorState())
+            st.max_task_retries = spec.max_task_retries
+            st.max_concurrency = max(1, spec.max_concurrency)
+        self.io.run(self.controller.call("register_actor", {"spec": spec}))
+
+    def _on_actor_push(self, msg: Dict[str, Any]) -> None:
+        actor_id = msg["actor_id"]
+        with self._actors_lock:
+            st = self._actors.setdefault(actor_id, _ActorState())
+            st.state = msg["state"]
+            if msg.get("address") is not None:
+                st.address = msg["address"]
+            if msg.get("reason"):
+                st.reason = msg["reason"]
+            st.event.set()
+
+    def _on_pg_push(self, msg: Dict[str, Any]) -> None:
+        self._pg_states[msg["pg_id"]] = msg["state"]
+        ev = self._pg_events.get(msg["pg_id"])
+        if ev is not None:
+            ev.set()
+
+    async def _resolve_actor(self, actor_id: ActorID) -> _ActorState:
+        with self._actors_lock:
+            st = self._actors.setdefault(actor_id, _ActorState())
+        deadline = time.monotonic() + 120
+        loop = asyncio.get_event_loop()
+        while time.monotonic() < deadline:
+            if st.state == "ALIVE" and st.address is not None:
+                return st
+            if st.state == "DEAD":
+                return st
+            info = await self.controller.call("get_actor_info", {"actor_id": actor_id})
+            if info is not None:
+                with self._actors_lock:
+                    st.state = info["state"]
+                    st.address = info["address"]
+                    st.reason = info.get("reason", "")
+                    st.max_concurrency = info.get("max_concurrency", st.max_concurrency)
+                    st.max_task_retries = info.get("max_task_retries", st.max_task_retries)
+                if st.state in ("ALIVE", "DEAD") and (st.state == "DEAD" or st.address):
+                    return st
+            await asyncio.sleep(0.05)
+        raise RayTpuError(f"actor {actor_id.hex()[:8]} did not become ready")
+
+    def submit_actor_task(self, spec: TaskSpec) -> None:
+        for oid in spec.return_ids:
+            self.refcounter.create_pending(oid, hold=True)
+        self._pin_deps(spec)
+        self.io.post(self._enqueue_actor_task(spec))
+
+    async def _enqueue_actor_task(self, spec: TaskSpec) -> None:
+        """Per-actor ordered dispatch (``SequentialActorSubmitQueue``):
+        calls to a max_concurrency==1 actor are pushed strictly in
+        submission order; concurrent/async actors dispatch directly."""
+        with self._actors_lock:
+            st = self._actors.setdefault(spec.actor_id, _ActorState())
+        if st.max_concurrency > 1:
+            asyncio.ensure_future(self._submit_actor(spec))
+            return
+        q = self._actor_queues.get(spec.actor_id)
+        if q is None:
+            q = self._actor_queues[spec.actor_id] = asyncio.Queue()
+            self._pump_tasks.append(asyncio.ensure_future(self._actor_pump(spec.actor_id, q)))
+        q.put_nowait(spec)
+
+    async def _actor_pump(self, actor_id: ActorID, q: "asyncio.Queue") -> None:
+        while not self._stopping:
+            spec = await q.get()
+            await self._submit_actor(spec)
+
+    async def _submit_actor(self, spec: TaskSpec) -> None:
+        try:
+            await self._submit_actor_inner(spec)
+        except Exception as e:  # noqa: BLE001 — never leave returns pending
+            logger.exception("actor task %s submission failed", spec.name)
+            self._fail_returns(spec, e if isinstance(e, RayTpuError) else RayTpuError(repr(e)))
+
+    async def _submit_actor_inner(self, spec: TaskSpec) -> None:
+        try:
+            with self._actors_lock:
+                st = self._actors.setdefault(spec.actor_id, _ActorState())
+            retries_left = st.max_task_retries
+            while True:
+                st = await self._resolve_actor(spec.actor_id)
+                if st.state == "DEAD":
+                    self._fail_returns(
+                        spec, ActorDiedError(spec.actor_id, st.reason or "actor is dead")
+                    )
+                    return
+                client = self._client(st.address.host, st.address.port)
+                try:
+                    reply = await client.call("push_task", {"spec": spec}, timeout=None, connect_timeout=3.0)
+                except ConnectionLost:
+                    # actor possibly restarting — consult the controller
+                    info = await self.controller.call("get_actor_info", {"actor_id": spec.actor_id})
+                    with self._actors_lock:
+                        if info is not None:
+                            st.state = info["state"]
+                            st.address = info["address"]
+                            st.reason = info.get("reason", "")
+                        else:
+                            st.state = "DEAD"
+                    if st.state == "DEAD" or retries_left <= 0:
+                        self._fail_returns(
+                            spec,
+                            ActorDiedError(
+                                spec.actor_id,
+                                st.reason or "actor worker died mid-call",
+                            ),
+                        )
+                        return
+                    retries_left -= 1
+                    await asyncio.sleep(0.1)
+                    continue
+                self._process_reply(spec, reply, 0)
+                return
+        finally:
+            self._unpin_deps(spec)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        self.io.run(
+            self.controller.call("kill_actor", {"actor_id": actor_id, "no_restart": no_restart})
+        )
+
+    def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
+        # Round 1: cooperative cancellation of queued work only.
+        logger.warning("cancel() is best-effort in this version")
+
+    def get_named_actor(self, name: str, namespace: str):
+        info = self.io.run(
+            self.controller.call("get_named_actor", {"name": name, "namespace": namespace})
+        )
+        if info is None:
+            return None
+        return (info["actor_id"], info["method_opts"], info["owner"])
+
+    def list_named_actors(self, all_namespaces: bool):
+        return self.io.run(
+            self.controller.call("list_named_actors", {"all_namespaces": all_namespaces})
+        )
+
+    # ------------------------------------------------------------------
+    # placement groups (client side)
+    def create_pg(self, pg_id: bytes, bundles, strategy: str, name: str = "") -> None:
+        self._pg_events.setdefault(pg_id, threading.Event())
+        self.io.run(
+            self.controller.call(
+                "create_pg",
+                {"pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name},
+            )
+        )
+
+    def wait_pg_ready(self, pg_id: bytes, timeout: Optional[float]) -> str:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ev = self._pg_events.setdefault(pg_id, threading.Event())
+        while True:
+            state = self._pg_states.get(pg_id)
+            if state is None:
+                info = self.io.run(self.controller.call("get_pg", {"pg_id": pg_id}))
+                state = info["state"] if info else None
+                if state:
+                    self._pg_states[pg_id] = state
+            if state in ("CREATED", "INFEASIBLE", "REMOVED"):
+                return state
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return state or "PENDING"
+            ev.wait(min(0.2, remaining) if remaining is not None else 0.2)
+
+    def remove_pg(self, pg_id: bytes) -> None:
+        self.io.run(self.controller.call("remove_pg", {"pg_id": pg_id}))
+
+    def get_pg(self, pg_id: bytes):
+        return self.io.run(self.controller.call("get_pg", {"pg_id": pg_id}))
+
+    # ------------------------------------------------------------------
+    # kv / cluster info
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self.io.run(self.controller.call("kv_put", {"key": key, "value": value}))
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self.io.run(self.controller.call("kv_get", {"key": key}))
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.io.run(self.controller.call("cluster_resources"))
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.io.run(self.controller.call("available_resources"))
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        return self.io.run(self.controller.call("nodes"))
+
+    # ------------------------------------------------------------------
+    # owner services (every process with a CoreWorker serves these)
+    async def w_get_object_status(self, payload, conn):
+        oid = ObjectID(payload["object_id"])
+        timeout = payload.get("timeout", 30.0)
+        if not self.refcounter.owns(oid):
+            data = self.memory.get(oid)
+            if data is not None:
+                return {"status": "inline", "data": data}
+            return {"status": "unknown"}
+        loop = asyncio.get_event_loop()
+        obj = (
+            self.refcounter.get(oid)
+            if timeout == 0
+            else await loop.run_in_executor(None, self.refcounter.wait_ready, oid, timeout)
+        )
+        if obj is None:
+            return {"status": "unknown"}
+        if obj.state == ObjState.FAILED:
+            return {"status": "error", "error": pickle.dumps(obj.error)}
+        if obj.state != ObjState.AVAILABLE:
+            return {"status": "pending"}
+        if obj.inline is not None:
+            return {"status": "inline", "data": obj.inline}
+        return {"status": "locations", "locations": list(obj.locations)}
+
+    async def w_add_borrower(self, payload, conn):
+        self.refcounter.add_borrower(ObjectID(payload["object_id"]))
+        return True
+
+    async def w_remove_borrower(self, payload, conn):
+        self.refcounter.remove_borrower(ObjectID(payload["object_id"]))
+        return True
+
+    async def w_delete_object(self, payload, conn):
+        self.memory.delete(ObjectID(payload["object_id"]))
+        return True
+
+    async def w_ping(self, payload, conn):
+        return "pong"
+
+    # execution services are registered when an executor is attached
+    async def w_push_task(self, payload, conn):
+        if self.executor is None:
+            raise RuntimeError("this process does not execute tasks")
+        return await self.executor.handle_push_task(payload["spec"])
+
+    async def w_run_actor_creation(self, payload, conn):
+        if self.executor is None:
+            raise RuntimeError("this process does not execute tasks")
+        return await self.executor.handle_actor_creation(payload["spec"])
+
+    async def w_exit(self, payload, conn):
+        import os
+
+        self.io.loop.call_later(0.05, os._exit, 0)
+        return True
+
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        self._stopping = True
+
+        async def _teardown():
+            for t in self._pump_tasks:
+                t.cancel()
+            for c in self._clients.values():
+                await c.close()
+            await self.controller.close()
+            await self.daemon.close()
+            await self.server.stop()
+
+        try:
+            self.io.run(_teardown(), timeout=5)
+        except Exception:
+            pass
+        self.shm.close_all()
+        self.io.stop()

@@ -71,7 +71,13 @@ def run_function(spec: TaskSpec, fn: Callable, args: list, kwargs: dict) -> List
         if inspect.iscoroutine(result):
             import asyncio
 
-            result = asyncio.get_event_loop().run_until_complete(result)
+            # Runs on an execution lane thread (no ambient event loop):
+            # drive the coroutine on a private loop.
+            loop = asyncio.new_event_loop()
+            try:
+                result = loop.run_until_complete(result)
+            finally:
+                loop.close()
         return unpack_returns(spec, result)
     except Exception as e:  # noqa: BLE001 - user code boundary
         err = TaskError(spec.name, e)

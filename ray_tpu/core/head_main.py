@@ -1,0 +1,70 @@
+"""Head process: controller + head-node daemon in one process.
+
+Reference topology: head node runs ``gcs_server`` + ``raylet``
+(``_private/node.py:1354``); here both live on one asyncio loop in one
+process. Prints a single JSON line with the ports so the spawning driver
+can connect.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+
+
+async def amain(args) -> None:
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.core.controller import Controller
+    from ray_tpu.core.node_daemon import NodeDaemon
+
+    if args.system_config:
+        GLOBAL_CONFIG.apply_system_config(json.loads(args.system_config))
+    controller = Controller()
+    cport = await controller.start()
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = args.num_cpus
+    daemon = NodeDaemon(
+        "127.0.0.1",
+        cport,
+        resources=resources or None,
+        session_dir=args.session_dir,
+    )
+    dport = await daemon.start()
+    print(json.dumps({"controller_port": cport, "daemon_port": dport}), flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await daemon.stop()
+    await controller.stop()
+
+
+def main() -> None:
+    import faulthandler
+
+    faulthandler.enable()
+    faulthandler.register(signal.SIGUSR2, all_threads=True)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--resources", type=str, default="")
+    parser.add_argument("--session-dir", type=str, default=None)
+    parser.add_argument("--system-config", type=str, default="")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -75,11 +75,13 @@ class LocalBackend(RuntimeBackend):
             for oid in object_ids:
                 self._store.pop(oid, None)
 
-    def add_local_ref(self, object_id: ObjectID) -> None:
+    def add_local_ref(self, ref: ObjectRef) -> None:
+        object_id = ref.id()
         with self._lock:
             self._refcounts[object_id] = self._refcounts.get(object_id, 0) + 1
 
-    def remove_local_ref(self, object_id: ObjectID) -> None:
+    def remove_local_ref(self, ref: ObjectRef) -> None:
+        object_id = ref.id()
         with self._lock:
             n = self._refcounts.get(object_id, 0) - 1
             if n <= 0:

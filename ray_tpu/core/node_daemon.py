@@ -1,0 +1,568 @@
+"""Per-node daemon (raylet equivalent).
+
+Reference: ``src/ray/raylet/`` — the node-local authority owning the shm
+object store thread (``object_manager/object_manager.cc:28-41``), the
+worker pool with startup tokens (``worker_pool.h:83``), the lease protocol
+(``NodeManager::HandleRequestWorkerLease``, ``node_manager.cc:1797``),
+local + spillback scheduling, placement-group bundle reservation 2PC
+(``placement_group_resource_manager.{h,cc}``), and node-to-node object
+transfer (``object_manager/``: pull/push with 5 MiB chunks).
+
+Design notes vs. the reference:
+  * Leases are granted against fixed-point local resources; when the local
+    node can't fit (or exceeds the hybrid threshold) the reply carries a
+    *spillback* target chosen from the controller-synced cluster view —
+    the submitter re-requests there, exactly like raylet spillback.
+  * Object transfer is daemon↔daemon chunked RPC pull; POSIX shm unlink
+    semantics stand in for plasma's pinning during reads.
+  * Workers are spawned as ``python -m ray_tpu.core.worker_main`` with a
+    spawn token; the pool correlates registration with purpose (idle pool
+    vs. dedicated actor worker — reference dedicated workers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID
+from ray_tpu.core.object_store import ShmStore
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.rpc import RpcClient, RpcServer, ServerConnection
+from ray_tpu.core.scheduling_policies import (
+    feasible_anywhere,
+    fits,
+    pick_node_hybrid,
+    utilization,
+)
+from ray_tpu.core.task_spec import DefaultScheduling, PlacementGroupScheduling, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerProc:
+    pid: int
+    proc: subprocess.Popen
+    token: str
+    host: str = ""
+    port: int = 0
+    registered: bool = False
+    leased: bool = False
+    claimed: bool = False  # a pending _pop_worker will take this worker
+    actor_id: Optional[ActorID] = None
+    # resources held by a dedicated actor worker, released on its death
+    actor_resources: Optional[Dict[str, float]] = None
+    actor_bundle_key: Optional[Tuple[bytes, int]] = None
+    conn: Optional[ServerConnection] = None
+    client: Optional[RpcClient] = None
+
+
+@dataclass
+class Lease:
+    lease_id: int
+    resources: Dict[str, float]
+    worker: WorkerProc
+    bundle_key: Optional[Tuple[bytes, int]] = None
+
+
+@dataclass
+class _ViewNode:
+    node_id: bytes
+    host: str
+    port: int
+    total: Dict[str, float]
+    available: Dict[str, float]
+    labels: Dict[str, str]
+
+
+class NodeDaemon:
+    def __init__(
+        self,
+        controller_host: str,
+        controller_port: int,
+        *,
+        resources: Optional[Dict[str, float]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        session_dir: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        self.node_id = NodeID.from_random()
+        self.host = host
+        self.server = RpcServer(host, port)
+        self.controller = RpcClient(controller_host, controller_port, name="controller")
+        self.controller_addr = (controller_host, controller_port)
+        res = dict(resources or {})
+        res.setdefault("CPU", float(os.cpu_count() or 1))
+        self.resources = NodeResources(ResourceSet(res), labels=labels)
+        self.store = ShmStore()
+        self.session_dir = session_dir or f"/tmp/ray_tpu/session_{os.getpid()}"
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.workers: Dict[str, WorkerProc] = {}  # token -> proc
+        self.idle: List[WorkerProc] = []
+        self.leases: Dict[int, Lease] = {}
+        self._lease_counter = 0
+        self._pending_actor_specs: Dict[str, TaskSpec] = {}  # token -> spec
+        self._bundle_pools: Dict[Tuple[bytes, int], NodeResources] = {}
+        self._prepared_bundles: Dict[Tuple[bytes, int], Dict[str, float]] = {}
+        self._view: List[_ViewNode] = []
+        self._peer_clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._stopping = False
+        for name in [m for m in dir(self) if m.startswith("d_")]:
+            self.server.register(name[2:], getattr(self, name))
+
+    # ---- lifecycle -----------------------------------------------------
+    async def start(self) -> int:
+        port = await self.server.start()
+        self.port = port
+        await self.controller.call(
+            "register_node",
+            {
+                "node_id": self.node_id.binary(),
+                "host": self.host,
+                "port": port,
+                "resources": self.resources.total.to_dict(),
+                "labels": self.resources.labels,
+            },
+            retries=GLOBAL_CONFIG.rpc_max_retries,
+        )
+        self._tasks.append(asyncio.ensure_future(self._sync_loop()))
+        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        return port
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for w in self.workers.values():
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        for w in self.workers.values():
+            try:
+                w.proc.wait(timeout=2)
+            except Exception:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        await self.controller.close()
+        for c in self._peer_clients.values():
+            await c.close()
+        self.store.shutdown()
+        await self.server.stop()
+
+    # ---- resource sync (ray_syncer) -----------------------------------
+    async def _sync_loop(self) -> None:
+        while not self._stopping:
+            try:
+                reply = await self.controller.call(
+                    "sync_resources",
+                    {
+                        "node_id": self.node_id.binary(),
+                        "available": self.resources.available.to_dict(),
+                        "total": self.resources.total.to_dict(),
+                    },
+                    timeout=5,
+                )
+                self._view = [
+                    _ViewNode(
+                        node_id=n["node_id"],
+                        host=n["host"],
+                        port=n["port"],
+                        total=n["total"],
+                        available=n["available"],
+                        labels=n.get("labels", {}),
+                    )
+                    for n in reply["view"]
+                ]
+            except Exception:
+                if not self._stopping:
+                    logger.debug("resource sync failed", exc_info=True)
+            await asyncio.sleep(0.2)
+
+    # ---- worker pool ---------------------------------------------------
+    def _spawn_worker(self, actor_spec: Optional[TaskSpec] = None) -> WorkerProc:
+        token = os.urandom(8).hex()
+        log_path = os.path.join(self.session_dir, "logs", f"worker-{token}.log")
+        log_f = open(log_path, "ab")
+        env = dict(os.environ)
+        env["RAY_TPU_SPAWN_TOKEN"] = token
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_DAEMON_ADDR"] = f"{self.host}:{self.port}"
+        env["RAY_TPU_CONTROLLER_ADDR"] = f"{self.controller_addr[0]}:{self.controller_addr[1]}"
+        env.pop("JAX_PLATFORMS", None)  # workers decide their own platform
+        # Workers share the daemon's process group so a hard node kill
+        # (killpg, cluster_utils.remove_node) takes them down too.
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+        )
+        w = WorkerProc(pid=proc.pid, proc=proc, token=token)
+        self.workers[token] = w
+        if actor_spec is not None:
+            w.actor_id = actor_spec.actor_id
+            self._pending_actor_specs[token] = actor_spec
+        return w
+
+    async def d_register_worker(self, payload, conn: ServerConnection):
+        token = payload["token"]
+        w = self.workers.get(token)
+        if w is None:
+            raise ValueError(f"unknown spawn token {token}")
+        w.host, w.port = payload["host"], payload["port"]
+        w.registered = True
+        w.conn = conn
+        conn.peer_tags["worker_token"] = token
+        w.client = RpcClient(w.host, w.port, name=f"worker-{token[:6]}")
+        spec = self._pending_actor_specs.pop(token, None)
+        if spec is not None:
+            asyncio.ensure_future(self._run_actor_creation(w, spec))
+        elif not w.claimed:
+            # Workers spawned by a waiting _pop_worker are claimed by that
+            # lease — adding them to the idle pool too would double-grant
+            # one worker to two leases (deadlock on its execution lane).
+            self.idle.append(w)
+        return {"node_id": self.node_id.binary()}
+
+    async def _run_actor_creation(self, w: WorkerProc, spec: TaskSpec) -> None:
+        try:
+            await w.client.call("run_actor_creation", {"spec": spec}, timeout=None)
+        except Exception as e:
+            logger.warning("actor creation dispatch failed: %r", e)
+            try:
+                await self.controller.call(
+                    "report_actor_death",
+                    {"actor_id": spec.actor_id, "reason": f"worker failed: {e!r}"},
+                )
+            except Exception:
+                pass
+
+    async def _reap_loop(self) -> None:
+        """Detect worker process deaths (reference: raylet notices socket
+        close; here we also poll the pid)."""
+        while not self._stopping:
+            for token, w in list(self.workers.items()):
+                code = w.proc.poll()
+                if code is None:
+                    continue
+                del self.workers[token]
+                if w in self.idle:
+                    self.idle.remove(w)
+                for lease_id, lease in list(self.leases.items()):
+                    if lease.worker is w:
+                        self._release_lease(lease_id)
+                self._release_actor_resources(w)
+                if w.actor_id is not None:
+                    try:
+                        await self.controller.call(
+                            "report_actor_death",
+                            {
+                                "actor_id": w.actor_id,
+                                "reason": f"worker exited with code {code}",
+                            },
+                        )
+                    except Exception:
+                        pass
+            await asyncio.sleep(0.1)
+
+    # ---- leases (task scheduling) -------------------------------------
+    async def d_request_lease(self, payload, conn):
+        """The lease hot path (``HandleRequestWorkerLease``)."""
+        request: Dict[str, float] = payload["resources"]
+        strategy = payload.get("strategy")
+        # Placement-group leases consume from the bundle pool.
+        bundle_key = None
+        if isinstance(strategy, PlacementGroupScheduling):
+            bundle_key = self._find_bundle(strategy, request)
+            if bundle_key is None:
+                return {"retry_after": 0.1}
+            pool = self._bundle_pools[bundle_key]
+            req = ResourceSet(request)
+            pool.allocate(req)
+        else:
+            req = ResourceSet(request)
+            if not self.resources.can_fit(req):
+                return self._spillback_or_retry(request, strategy)
+            # hybrid: spill when local utilization is past the threshold
+            if (
+                self.resources.utilization() >= GLOBAL_CONFIG.scheduler_spread_threshold
+                and len(self._view) > 1
+            ):
+                alt = self._pick_remote(request, strategy)
+                if alt is not None and alt.node_id != self.node_id.binary():
+                    return {"spillback": (alt.host, alt.port)}
+            self.resources.allocate(req)
+
+        worker = await self._pop_worker()
+        if worker is None:
+            if bundle_key is not None:
+                self._bundle_pools[bundle_key].release(ResourceSet(request))
+            else:
+                self.resources.release(ResourceSet(request))
+            return {"retry_after": 0.05}
+        worker.leased = True
+        self._lease_counter += 1
+        lease = Lease(self._lease_counter, request, worker, bundle_key)
+        self.leases[lease.lease_id] = lease
+        return {
+            "grant": {
+                "lease_id": lease.lease_id,
+                "host": worker.host,
+                "port": worker.port,
+                "node_id": self.node_id.binary(),
+            }
+        }
+
+    def _find_bundle(self, strategy: PlacementGroupScheduling, request) -> Optional[Tuple[bytes, int]]:
+        if strategy.bundle_index >= 0:
+            key = (strategy.pg_id, strategy.bundle_index)
+            pool = self._bundle_pools.get(key)
+            if pool is not None and pool.can_fit(ResourceSet(request)):
+                return key
+            return None
+        for key, pool in self._bundle_pools.items():
+            if key[0] == strategy.pg_id and pool.can_fit(ResourceSet(request)):
+                return key
+        return None
+
+    def _spillback_or_retry(self, request, strategy):
+        alt = self._pick_remote(request, strategy)
+        if alt is not None and alt.node_id != self.node_id.binary():
+            return {"spillback": (alt.host, alt.port)}
+        if self._view and not feasible_anywhere(self._view, request):
+            return {"infeasible": True}
+        return {"retry_after": 0.05}
+
+    def _pick_remote(self, request, strategy):
+        return pick_node_hybrid(
+            self._view,
+            request,
+            strategy if strategy is not None else DefaultScheduling(),
+            local_node_id=self.node_id.binary(),
+            spread_threshold=GLOBAL_CONFIG.scheduler_spread_threshold,
+        )
+
+    async def _pop_worker(self) -> Optional[WorkerProc]:
+        while self.idle:
+            w = self.idle.pop()
+            if w.proc.poll() is None and w.registered:
+                return w
+        # cold start (startup token accounting: bounded concurrent spawns)
+        starting = sum(
+            1 for w in self.workers.values() if not w.registered and w.actor_id is None
+        )
+        if starting >= GLOBAL_CONFIG.worker_maximum_startup_concurrency:
+            return None
+        w = self._spawn_worker()
+        w.claimed = True
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if w.registered:
+                w.claimed = False
+                return w
+            if w.proc.poll() is not None:
+                w.claimed = False
+                return None
+            await asyncio.sleep(0.01)
+        # spawn timed out: release the claim; if it registered late, give
+        # it to the idle pool so it isn't orphaned
+        w.claimed = False
+        if w.registered and not w.leased and w not in self.idle:
+            self.idle.append(w)
+        return None
+
+    async def d_return_lease(self, payload, conn):
+        self._release_lease(payload["lease_id"])
+        return True
+
+    def _release_lease(self, lease_id: int) -> None:
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        req = ResourceSet(lease.resources)
+        if lease.bundle_key is not None:
+            pool = self._bundle_pools.get(lease.bundle_key)
+            if pool is not None:
+                pool.release(req)
+        else:
+            self.resources.release(req)
+        w = lease.worker
+        w.leased = False
+        if w.proc.poll() is None and w.registered and w.actor_id is None and w not in self.idle:
+            self.idle.append(w)
+
+    # ---- actors --------------------------------------------------------
+    async def d_start_actor(self, payload, conn):
+        spec: TaskSpec = payload["spec"]
+        req = ResourceSet(spec.resources)
+        bundle_key = None
+        if isinstance(spec.scheduling_strategy, PlacementGroupScheduling):
+            bundle_key = self._find_bundle(spec.scheduling_strategy, spec.resources)
+            if bundle_key is None:
+                raise RuntimeError("no bundle capacity for actor")
+            self._bundle_pools[bundle_key].allocate(req)
+        else:
+            if not self.resources.can_fit(req):
+                raise RuntimeError("insufficient resources for actor")
+            self.resources.allocate(req)
+        w = self._spawn_worker(actor_spec=spec)
+        w.actor_resources = dict(spec.resources)
+        w.actor_bundle_key = bundle_key
+        return {"pid": w.pid}
+
+    def _release_actor_resources(self, w: WorkerProc) -> None:
+        if w.actor_resources is None:
+            return
+        req = ResourceSet(w.actor_resources)
+        w.actor_resources = None
+        if w.actor_bundle_key is not None:
+            pool = self._bundle_pools.get(w.actor_bundle_key)
+            if pool is not None:
+                pool.release(req)
+        else:
+            self.resources.release(req)
+
+    async def d_kill_worker(self, payload, conn):
+        actor_id = payload.get("actor_id")
+        pid = payload.get("pid")
+        for w in list(self.workers.values()):
+            if (actor_id is not None and w.actor_id == actor_id) or (pid and w.pid == pid):
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+                return True
+        return False
+
+    # ---- placement group bundles (2PC) --------------------------------
+    async def d_prepare_bundle(self, payload, conn):
+        key = (payload["pg_id"], payload["bundle_index"])
+        req = ResourceSet(payload["resources"])
+        if key in self._prepared_bundles or key in self._bundle_pools:
+            return True
+        if not self.resources.can_fit(req):
+            raise RuntimeError("cannot reserve bundle: insufficient resources")
+        self.resources.allocate(req)
+        self._prepared_bundles[key] = payload["resources"]
+        return True
+
+    async def d_commit_bundle(self, payload, conn):
+        key = (payload["pg_id"], payload["bundle_index"])
+        resources = self._prepared_bundles.pop(key, None)
+        if resources is None:
+            if key in self._bundle_pools:
+                return True
+            raise RuntimeError("commit without prepare")
+        self._bundle_pools[key] = NodeResources(ResourceSet(resources))
+        return True
+
+    async def d_release_bundle(self, payload, conn):
+        key = (payload["pg_id"], payload["bundle_index"])
+        resources = self._prepared_bundles.pop(key, None)
+        if resources is not None:
+            self.resources.release(ResourceSet(resources))
+        pool = self._bundle_pools.pop(key, None)
+        if pool is not None:
+            self.resources.release(pool.total)
+        return True
+
+    # ---- object store services ----------------------------------------
+    async def d_adopt_object(self, payload, conn):
+        self.store.adopt(ObjectID(payload["object_id"]), payload["size"])
+        return True
+
+    async def d_get_object_meta(self, payload, conn):
+        meta = self.store.ensure_local(ObjectID(payload["object_id"]))
+        if meta is None:
+            return None
+        return {"segment": meta[0], "size": meta[1]}
+
+    async def d_pull_object(self, payload, conn):
+        """Ensure the object is in the local store, pulling chunks from a
+        source node (``PullManager``/``PushManager`` equivalent)."""
+        object_id = ObjectID(payload["object_id"])
+        meta = self.store.ensure_local(object_id)
+        if meta is not None:
+            return {"segment": meta[0], "size": meta[1]}
+        for host, port in payload["sources"]:
+            client = self._peer(host, port)
+            try:
+                head = await client.call(
+                    "object_info", {"object_id": object_id.binary()}, timeout=10
+                )
+                if head is None:
+                    continue
+                size = head["size"]
+                chunk = GLOBAL_CONFIG.object_transfer_chunk_bytes
+                buf = bytearray(size)
+                off = 0
+                while off < size:
+                    data = await client.call(
+                        "fetch_chunk",
+                        {"object_id": object_id.binary(), "offset": off, "length": min(chunk, size - off)},
+                        timeout=60,
+                    )
+                    buf[off : off + len(data)] = data
+                    off += len(data)
+                self.store.create_with_data(object_id, memoryview(buf))
+                meta = self.store.ensure_local(object_id)
+                return {"segment": meta[0], "size": meta[1]}
+            except Exception:
+                logger.warning("pull from %s:%s failed", host, port, exc_info=True)
+        return None
+
+    async def d_object_info(self, payload, conn):
+        object_id = ObjectID(payload["object_id"])
+        meta = self.store.ensure_local(object_id)
+        if meta is None:
+            return None
+        return {"size": meta[1]}
+
+    async def d_fetch_chunk(self, payload, conn):
+        object_id = ObjectID(payload["object_id"])
+        data = self.store.read_range(object_id, payload["offset"], payload["length"])
+        if data is None:
+            raise KeyError(f"object {object_id.hex()[:12]} not here")
+        return data
+
+    async def d_delete_object(self, payload, conn):
+        self.store.delete(ObjectID(payload["object_id"]))
+        return True
+
+    def _peer(self, host: str, port: int) -> RpcClient:
+        key = (host, port)
+        client = self._peer_clients.get(key)
+        if client is None:
+            client = self._peer_clients[key] = RpcClient(host, port, name=f"peer-{port}")
+        return client
+
+    # ---- misc ----------------------------------------------------------
+    async def d_ping(self, payload, conn):
+        return "pong"
+
+    async def d_hello(self, payload, conn):
+        """Driver handshake: learn the local node id."""
+        return {"node_id": self.node_id.binary()}
+
+    async def d_stats(self, payload, conn):
+        return {
+            "node_id": self.node_id.binary(),
+            "store": self.store.stats(),
+            "num_workers": len(self.workers),
+            "num_idle": len(self.idle),
+            "num_leases": len(self.leases),
+            "resources": self.resources.to_dict(),
+        }

@@ -1,0 +1,66 @@
+"""Standalone node daemon process (worker nodes / simulated multi-node).
+
+Reference: ``raylet/main.cc:123`` — boots a NodeManager against an
+existing control plane. Used by the test ``Cluster`` fixture
+(``cluster_utils.py``) to add nodes on one machine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+
+
+async def amain(args) -> None:
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.core.node_daemon import NodeDaemon
+
+    if args.system_config:
+        GLOBAL_CONFIG.apply_system_config(json.loads(args.system_config))
+    host, cport = args.controller.rsplit(":", 1)
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = args.num_cpus
+    labels = json.loads(args.labels) if args.labels else {}
+    daemon = NodeDaemon(
+        host,
+        int(cport),
+        resources=resources or None,
+        session_dir=args.session_dir,
+        labels=labels,
+    )
+    dport = await daemon.start()
+    print(json.dumps({"daemon_port": dport, "node_id": daemon.node_id.hex()}), flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await daemon.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--controller", type=str, required=True)
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--resources", type=str, default="")
+    parser.add_argument("--labels", type=str, default="")
+    parser.add_argument("--session-dir", type=str, default=None)
+    parser.add_argument("--system-config", type=str, default="")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,234 @@
+"""Ownership-based distributed memory management.
+
+Reference: ``core_worker/reference_count.h:64`` — every object has exactly
+one *owner*: the worker that created it (``put``) or submitted its
+producing task. The owner holds the authoritative state machine
+
+    PENDING → AVAILABLE(inline bytes | shm locations) | FAILED(error)
+                      ↓
+                    FREED
+
+and the reference count split into local refs (ObjectRefs in the owner
+process), *borrowers* (other processes that deserialized a ref), and
+submitted-task references (the ref is an argument of an in-flight task).
+When all three hit zero the object is freed: inline bytes dropped, every
+node holding a shm copy told to delete. The producing ``TaskSpec`` is
+retained while the object or any downstream dependent lives
+(lineage pinning, ``reference_count.h:70-117``) so lost objects can be
+reconstructed by resubmission (``object_recovery_manager.h:90``).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ray_tpu.core.ids import ObjectID
+
+logger = logging.getLogger(__name__)
+
+
+class ObjState(enum.Enum):
+    PENDING = 0
+    AVAILABLE = 1
+    FAILED = 2
+    FREED = 3
+
+
+@dataclass
+class OwnedObject:
+    state: ObjState = ObjState.PENDING
+    inline: Optional[bytes] = None  # serialized value, for small objects
+    locations: Set[bytes] = field(default_factory=set)  # node ids with a shm copy
+    error: Optional[Exception] = None
+    local_refs: int = 0
+    borrowers: int = 0
+    submitted: int = 0
+    # refs contained in this object's value: kept alive while this lives
+    contained: List[Any] = field(default_factory=list)
+    lineage: Optional[Any] = None  # producing TaskSpec (reconstruction)
+    waiters: List[threading.Event] = field(default_factory=list)
+
+    def ready(self) -> bool:
+        return self.state in (ObjState.AVAILABLE, ObjState.FAILED)
+
+    def refcount(self) -> int:
+        return self.local_refs + self.borrowers + self.submitted
+
+
+class ReferenceCounter:
+    """Owner-side object table. Thread-safe (sync API + io thread)."""
+
+    def __init__(self, on_free: Callable[[ObjectID, OwnedObject], None]):
+        self._objects: Dict[ObjectID, OwnedObject] = {}
+        self._lock = threading.RLock()
+        self._on_free = on_free
+
+    # -- creation --------------------------------------------------------
+    # ``hold=True`` creates the entry with one synthetic local ref (the
+    # "submission hold"): the API layer releases it once real ObjectRefs
+    # exist, so a completion racing ref-construction can't free the object,
+    # while fire-and-forget objects (refs dropped while PENDING) are freed
+    # as soon as their result lands.
+    def create_pending(self, object_id: ObjectID, lineage=None, hold: bool = False) -> None:
+        with self._lock:
+            if object_id not in self._objects:
+                self._objects[object_id] = OwnedObject(
+                    lineage=lineage, local_refs=1 if hold else 0
+                )
+
+    def create_inline(self, object_id: ObjectID, data: bytes, contained=None, hold: bool = False) -> None:
+        self._complete(
+            object_id,
+            lambda obj: (
+                setattr(obj, "state", ObjState.AVAILABLE),
+                setattr(obj, "inline", data),
+                setattr(obj, "contained", list(contained or [])),
+            ),
+            hold=hold,
+        )
+
+    def create_at_location(self, object_id: ObjectID, node_id, contained=None, hold: bool = False) -> None:
+        def mutate(obj):
+            obj.state = ObjState.AVAILABLE
+            obj.locations.add(node_id)
+            obj.contained = list(contained or [])
+
+        self._complete(object_id, mutate, hold=hold)
+
+    def _complete(self, object_id: ObjectID, mutate, hold: bool = False) -> None:
+        free_obj = None
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None:
+                obj = self._objects[object_id] = OwnedObject(local_refs=1 if hold else 0)
+            mutate(obj)
+            self._wake(obj)
+            if obj.refcount() == 0:
+                free_obj = self._objects.pop(object_id)
+        if free_obj is not None:
+            free_obj.state = ObjState.FREED
+            try:
+                self._on_free(object_id, free_obj)
+            except Exception:
+                logger.exception("free callback failed for %s", object_id.hex()[:12])
+
+    # -- completion (task results) --------------------------------------
+    def mark_available_inline(self, object_id: ObjectID, data: bytes) -> None:
+        self.create_inline(object_id, data)
+
+    def mark_available_at(self, object_id: ObjectID, node_id) -> None:
+        self.create_at_location(object_id, node_id)
+
+    def mark_failed(self, object_id: ObjectID, error: Exception) -> None:
+        def mutate(obj):
+            obj.state = ObjState.FAILED
+            obj.error = error
+
+        self._complete(object_id, mutate)
+
+    def _wake(self, obj: OwnedObject) -> None:
+        for ev in obj.waiters:
+            ev.set()
+        obj.waiters.clear()
+
+    # -- queries ---------------------------------------------------------
+    def get(self, object_id: ObjectID) -> Optional[OwnedObject]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def owns(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def wait_ready(self, object_id: ObjectID, timeout: Optional[float]) -> Optional[OwnedObject]:
+        """Block until the object completes (owner-side get path)."""
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None:
+                return None
+            if obj.ready():
+                return obj
+            ev = threading.Event()
+            obj.waiters.append(ev)
+        if not ev.wait(timeout):
+            return None
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def add_location(self, object_id: ObjectID, node_id: bytes) -> None:
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                obj.locations.add(node_id)
+
+    def remove_location(self, object_id: ObjectID, node_id: bytes) -> bool:
+        """Node lost a copy. Returns True if the object now has no value
+        anywhere (candidate for lineage reconstruction)."""
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None:
+                return False
+            obj.locations.discard(node_id)
+            return obj.state == ObjState.AVAILABLE and not obj.locations and obj.inline is None
+
+    # -- refcounting -----------------------------------------------------
+    def add_local(self, object_id: ObjectID) -> None:
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                obj.local_refs += 1
+
+    def remove_local(self, object_id: ObjectID) -> None:
+        self._dec(object_id, "local_refs")
+
+    def add_borrower(self, object_id: ObjectID) -> None:
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                obj.borrowers += 1
+
+    def remove_borrower(self, object_id: ObjectID) -> None:
+        self._dec(object_id, "borrowers")
+
+    def add_submitted(self, object_id: ObjectID) -> None:
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                obj.submitted += 1
+
+    def remove_submitted(self, object_id: ObjectID) -> None:
+        self._dec(object_id, "submitted")
+
+    def _dec(self, object_id: ObjectID, attr: str) -> None:
+        free_obj = None
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None:
+                return
+            setattr(obj, attr, max(0, getattr(obj, attr) - 1))
+            if obj.refcount() == 0 and obj.ready():
+                free_obj = self._objects.pop(object_id)
+                free_obj.state = ObjState.FREED
+        if free_obj is not None:
+            try:
+                self._on_free(object_id, free_obj)
+            except Exception:
+                logger.exception("free callback failed for %s", object_id.hex()[:12])
+
+    def force_free(self, object_id: ObjectID) -> None:
+        with self._lock:
+            obj = self._objects.pop(object_id, None)
+        if obj is not None:
+            obj.state = ObjState.FREED
+            self._on_free(object_id, obj)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_owned": len(self._objects),
+                "num_pending": sum(1 for o in self._objects.values() if o.state == ObjState.PENDING),
+            }

@@ -1,0 +1,220 @@
+"""Cluster scheduling policies.
+
+Reference: ``src/ray/raylet/scheduling/`` — hybrid local-first/top-k policy
+(``hybrid_scheduling_policy.h:50``), spread, node-affinity, node-label
+(``composite_scheduling_policy.h:33``) and the bundle placement policies
+PACK / SPREAD / STRICT_PACK / STRICT_SPREAD
+(``bundle_scheduling_policy.h:82-106``). Policies here are pure functions
+over the synced cluster view (plain dicts) so both the controller (actor +
+PG placement) and node daemons (task spillback) share them.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ray_tpu.core.task_spec import (
+    DefaultScheduling,
+    NodeAffinityScheduling,
+    NodeLabelScheduling,
+    PlacementGroupScheduling,
+    SchedulingStrategy,
+    SpreadScheduling,
+)
+
+
+def fits(available: Dict[str, float], request: Dict[str, float]) -> bool:
+    return all(available.get(k, 0.0) + 1e-9 >= v for k, v in request.items())
+
+
+def utilization(total: Dict[str, float], available: Dict[str, float]) -> float:
+    """LeastResourceScorer (``scorer.h:41``): max over resources of
+    used/total."""
+    worst = 0.0
+    for k, t in total.items():
+        if t <= 0:
+            continue
+        worst = max(worst, (t - available.get(k, 0.0)) / t)
+    return worst
+
+
+@dataclass
+class BundleReservation:
+    node_id: bytes
+    bundle_index: int
+    resources: Dict[str, float]
+
+
+def pick_node_hybrid(
+    nodes: Sequence,  # NodeInfo-like: .node_id .total .available .labels
+    request: Dict[str, float],
+    strategy: SchedulingStrategy,
+    pgs: Optional[Dict[bytes, object]] = None,
+    local_node_id: Optional[bytes] = None,
+    spread_threshold: float = 0.5,
+):
+    """Pick a node for one task/actor. Returns the node object or None."""
+    if isinstance(strategy, NodeAffinityScheduling):
+        for n in nodes:
+            if n.node_id == strategy.node_id:
+                if fits(n.available, request):
+                    return n
+                # soft affinity: target full → fall back to any other fit
+                return _best_fit(nodes, request) if strategy.soft else None
+        return _best_fit(nodes, request) if strategy.soft else None
+
+    if isinstance(strategy, PlacementGroupScheduling) and pgs is not None:
+        pg = pgs.get(strategy.pg_id)
+        if pg is None or not getattr(pg, "reservations", None):
+            return None
+        node_ids = {r.bundle_index: r.node_id for r in pg.reservations}
+        if strategy.bundle_index >= 0:
+            target = node_ids.get(strategy.bundle_index)
+        else:
+            target = None
+            for r in pg.reservations:
+                target = r.node_id
+                break
+        for n in nodes:
+            if n.node_id == target:
+                return n
+        return None
+
+    if isinstance(strategy, NodeLabelScheduling):
+        def match(n, conditions):
+            return all(n.labels.get(k) in vals for k, vals in conditions)
+
+        hard = [n for n in nodes if match(n, strategy.hard) and fits(n.available, request)]
+        if hard:
+            soft = [n for n in hard if match(n, strategy.soft)]
+            return random.choice(soft or hard)
+        return None
+
+    if isinstance(strategy, SpreadScheduling):
+        candidates = [n for n in nodes if fits(n.available, request)]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda n: (utilization(n.total, n.available), random.random()))
+
+    # Default hybrid: prefer the local node while its utilization is below
+    # the threshold, else the best (least-utilized) remote fit
+    # (``hybrid_scheduling_policy.h:50``).
+    if local_node_id is not None:
+        local = next((n for n in nodes if n.node_id == local_node_id), None)
+        if (
+            local is not None
+            and fits(local.available, request)
+            and utilization(local.total, local.available) < spread_threshold
+        ):
+            return local
+    return _best_fit(nodes, request)
+
+
+def _best_fit(nodes: Sequence, request: Dict[str, float]):
+    candidates = [n for n in nodes if fits(n.available, request)]
+    if not candidates:
+        return None
+    candidates.sort(key=lambda n: utilization(n.total, n.available))
+    # top-k jitter to avoid thundering herds (reference top-k fraction)
+    k = max(1, len(candidates) // 5)
+    return random.choice(candidates[:k])
+
+
+def feasible_anywhere(nodes: Sequence, request: Dict[str, float]) -> bool:
+    return any(fits(n.total, request) for n in nodes)
+
+
+def place_bundles(
+    nodes: Sequence, bundles: List[Dict[str, float]], strategy: str
+) -> Optional[List[BundleReservation]]:
+    """Plan bundle→node placement (``bundle_scheduling_policy.h:82-106``).
+
+    Returns None if infeasible right now. Pure planning — reservation
+    happens via 2PC with the daemons afterwards.
+    """
+    avail = {n.node_id: dict(n.available) for n in nodes}
+    nodes_by_id = {n.node_id: n for n in nodes}
+
+    def take(node_id: bytes, req: Dict[str, float]) -> bool:
+        a = avail[node_id]
+        if not fits(a, req):
+            return False
+        for k, v in req.items():
+            a[k] = a.get(k, 0.0) - v
+        return True
+
+    plan: List[BundleReservation] = []
+
+    if strategy == "STRICT_PACK":
+        for node_id in avail:
+            trial = dict(avail[node_id])
+            ok = True
+            for b in bundles:
+                if not fits(trial, b):
+                    ok = False
+                    break
+                for k, v in b.items():
+                    trial[k] = trial.get(k, 0.0) - v
+            if ok:
+                return [
+                    BundleReservation(node_id, i, dict(b)) for i, b in enumerate(bundles)
+                ]
+        return None
+
+    if strategy == "STRICT_SPREAD":
+        used_nodes: set = set()
+        for i, b in enumerate(bundles):
+            placed = False
+            ranked = sorted(
+                (nid for nid in avail if nid not in used_nodes),
+                key=lambda nid: utilization(nodes_by_id[nid].total, avail[nid]),
+            )
+            for nid in ranked:
+                if take(nid, b):
+                    plan.append(BundleReservation(nid, i, dict(b)))
+                    used_nodes.add(nid)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return plan
+
+    if strategy == "SPREAD":
+        for i, b in enumerate(bundles):
+            ranked = sorted(
+                avail,
+                key=lambda nid: (
+                    sum(1 for r in plan if r.node_id == nid),
+                    utilization(nodes_by_id[nid].total, avail[nid]),
+                ),
+            )
+            placed = False
+            for nid in ranked:
+                if take(nid, b):
+                    plan.append(BundleReservation(nid, i, dict(b)))
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return plan
+
+    # PACK (default): minimize node count — greedy fill in utilization order.
+    for i, b in enumerate(bundles):
+        ranked = sorted(
+            avail,
+            key=lambda nid: (
+                -sum(1 for r in plan if r.node_id == nid),  # prefer already-used
+                utilization(nodes_by_id[nid].total, avail[nid]),
+            ),
+        )
+        placed = False
+        for nid in ranked:
+            if take(nid, b):
+                plan.append(BundleReservation(nid, i, dict(b)))
+                placed = True
+                break
+        if not placed:
+            return None
+    return plan

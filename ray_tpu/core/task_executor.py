@@ -1,0 +1,245 @@
+"""Worker-side task execution engine.
+
+Reference: ``core_worker/transport/task_receiver.h:91`` + the scheduling
+queues — normal tasks FIFO on a single lane; actor tasks ordered per
+caller by sequence number (``SequentialActorSubmitQueue``), thread-pool
+lanes for ``max_concurrency`` / concurrency groups
+(``ConcurrencyGroupManager``), an asyncio lane for async (coroutine)
+actor methods (fibers, ``fiber.h``), and result packaging: small returns
+inline in the reply, large returns into the node shm store
+(reference: task output plasma promotion).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import pickle
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import execution, serialization
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.task_spec import TaskKind, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+class TaskExecutor:
+    def __init__(self):
+        self.core = None  # CoreWorker
+        self.api_worker = None  # api.Worker
+        self._lanes: Dict[str, ThreadPoolExecutor] = {}
+        self._default_lane = ThreadPoolExecutor(max_workers=1, thread_name_prefix="exec")
+        self._actor_instance: Any = None
+        self._actor_spec: Optional[TaskSpec] = None
+        self._max_concurrency = 1
+        # per-caller ordering state: caller worker_id -> {next, cond}
+        self._seq: Dict[bytes, Dict[str, Any]] = {}
+        self._async_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._async_sem: Optional[asyncio.Semaphore] = None
+
+    def bind(self, core, api_worker) -> None:
+        self.core = core
+        self.api_worker = api_worker
+
+    # ------------------------------------------------------------------
+    def _lane_for(self, spec: TaskSpec) -> ThreadPoolExecutor:
+        if spec.concurrency_group and spec.concurrency_group in self._lanes:
+            return self._lanes[spec.concurrency_group]
+        return self._default_lane
+
+    def _get_dep(self, ref) -> Any:
+        values = self.core.get_objects([ref], timeout=None)
+        value = values[0]
+        if isinstance(value, Exception):
+            raise value if isinstance(value, TaskError) else TaskError("dependency", value)
+        return value
+
+    # ------------------------------------------------------------------
+    async def handle_actor_creation(self, spec: TaskSpec) -> Dict[str, Any]:
+        # The daemon can dispatch creation while our registration reply is
+        # still in flight; wait for the handshake to finish.
+        for _ in range(500):
+            if self.core is not None and self.core.address is not None:
+                break
+            await asyncio.sleep(0.01)
+        self._actor_spec = spec
+        self._max_concurrency = max(1, spec.max_concurrency)
+        if self._max_concurrency > 1:
+            self._default_lane = ThreadPoolExecutor(
+                max_workers=self._max_concurrency, thread_name_prefix="actor"
+            )
+        for group, limit in (spec.concurrency_groups or {}).items():
+            self._lanes[group] = ThreadPoolExecutor(max_workers=max(1, limit), thread_name_prefix=group)
+        loop = asyncio.get_event_loop()
+
+        def _create():
+            self.api_worker.job_id = spec.job_id
+            self.api_worker.set_task_context(spec.task_id)
+            cls = self.api_worker.fn_table.load(spec.function_id)
+            args, kwargs = execution.resolve_args(spec, self._get_dep)
+            self._actor_instance = cls(*args, **kwargs)
+
+        try:
+            await loop.run_in_executor(self._default_lane, _create)
+        except Exception as e:  # noqa: BLE001
+            err = TaskError(spec.name, e)
+            await self.core.controller.call(
+                "actor_creation_failed",
+                {
+                    "actor_id": spec.actor_id,
+                    "reason": f"creation failed: {e!r}",
+                    "error": pickle.dumps(err),
+                },
+            )
+            # exit so the daemon reaps this dedicated worker
+            self.core.io.loop.call_later(0.1, _exit_now)
+            return {"ok": False}
+        await self.core.controller.call(
+            "actor_ready",
+            {"actor_id": spec.actor_id, "address": self.core.address},
+        )
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    async def handle_push_task(self, spec: TaskSpec) -> Dict[str, Any]:
+        if spec.kind == TaskKind.ACTOR_TASK:
+            return await self._handle_actor_task(spec)
+        logger.debug("executing %s %s", spec.name, spec.task_id.hex()[:8])
+        loop = asyncio.get_event_loop()
+        results = await loop.run_in_executor(self._default_lane, self._execute, spec)
+        logger.debug("finished %s %s", spec.name, spec.task_id.hex()[:8])
+        return {"results": results}
+
+    async def _handle_actor_task(self, spec: TaskSpec) -> Dict[str, Any]:
+        # built-in methods
+        if spec.method_name == "__ray_ready__":
+            return {"results": self._package(spec, [(spec.return_ids[0], True)])}
+        if spec.method_name == "__ray_terminate__":
+            reply = {"results": self._package(spec, [(spec.return_ids[0], None)])}
+            self.core.io.loop.call_later(0.05, _exit_now)
+            return reply
+        method = getattr(self._actor_instance, spec.method_name, None)
+        if method is None:
+            err = TaskError(spec.name, AttributeError(f"no method {spec.method_name!r}"))
+            return {"results": [(oid.binary(), "error", pickle.dumps(err)) for oid in spec.return_ids]}
+        if inspect.iscoroutinefunction(method):
+            return await self._run_async_method(spec, method)
+        caller = spec.owner.worker_id if spec.owner else b""
+        if self._max_concurrency == 1 and not spec.concurrency_group:
+            await self._wait_turn(caller, spec.seq_no)
+            # submission order into the single-thread lane = execution order
+            loop = asyncio.get_event_loop()
+            fut = loop.run_in_executor(self._lane_for(spec), self._execute, spec)
+            self._advance(caller)
+            results = await fut
+        else:
+            loop = asyncio.get_event_loop()
+            results = await loop.run_in_executor(self._lane_for(spec), self._execute, spec)
+        return {"results": results}
+
+    async def _wait_turn(self, caller: bytes, seq: int) -> None:
+        state = self._seq.get(caller)
+        if state is None:
+            # Baseline at the first sequence number seen from this caller:
+            # after an actor restart the caller's counter keeps counting,
+            # so starting from 1 would deadlock (reference handles this via
+            # caller_starts_at in the actor submit queue).
+            state = self._seq[caller] = {"next": seq, "cond": asyncio.Condition()}
+        async with state["cond"]:
+            await state["cond"].wait_for(lambda: state["next"] >= seq)
+
+    def _advance(self, caller: bytes) -> None:
+        state = self._seq.get(caller)
+        if state is None:
+            return
+
+        async def _notify():
+            async with state["cond"]:
+                state["next"] += 1
+                state["cond"].notify_all()
+
+        asyncio.ensure_future(_notify())
+
+    async def _run_async_method(self, spec: TaskSpec, method) -> Dict[str, Any]:
+        """Async actor methods run on a dedicated loop with a
+        max_concurrency semaphore (reference: fibers for async actors)."""
+        if self._async_loop is None:
+            self._async_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self._async_loop.run_forever, daemon=True, name="actor-async")
+            t.start()
+
+        loop0 = asyncio.get_event_loop()
+        # arg resolution can block on remote objects — keep it off the io loop
+        args, kwargs = await loop0.run_in_executor(
+            None, execution.resolve_args, spec, self._get_dep
+        )
+
+        async def _run():
+            if self._async_sem is None:
+                self._async_sem = asyncio.Semaphore(max(1, self._max_concurrency))
+            async with self._async_sem:
+                return await method(*args, **kwargs)
+
+        cfut = asyncio.run_coroutine_threadsafe(_run(), self._async_loop)
+        loop = asyncio.get_event_loop()
+        try:
+            result = await loop.run_in_executor(None, cfut.result)
+            pairs = execution.unpack_returns(spec, result)
+        except Exception as e:  # noqa: BLE001
+            err = TaskError(spec.name, e)
+            pairs = [(oid, err) for oid in spec.return_ids]
+        # _package can RPC the daemon (large results) — keep it off the io loop
+        return {"results": await loop.run_in_executor(None, self._package, spec, pairs)}
+
+    # ------------------------------------------------------------------
+    def _execute(self, spec: TaskSpec) -> List[Tuple[bytes, str, Any]]:
+        """Runs on a lane thread. Returns packaged results."""
+        self.api_worker.job_id = spec.job_id
+        self.api_worker.set_task_context(spec.task_id)
+        try:
+            if spec.kind == TaskKind.ACTOR_TASK:
+                fn = getattr(self._actor_instance, spec.method_name)
+            else:
+                fn = self.api_worker.fn_table.load(spec.function_id)
+            args, kwargs = execution.resolve_args(spec, self._get_dep)
+        except Exception as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError(spec.name, e)
+            return [(oid.binary(), "error", pickle.dumps(err)) for oid in spec.return_ids]
+        pairs = execution.run_function(spec, fn, args, kwargs)
+        return self._package(spec, pairs)
+
+    def _package(self, spec: TaskSpec, pairs: List[Tuple[ObjectID, Any]]) -> List[Tuple[bytes, str, Any]]:
+        out: List[Tuple[bytes, str, Any]] = []
+        for oid, value in pairs:
+            if isinstance(value, TaskError):
+                out.append((oid.binary(), "error", pickle.dumps(value)))
+                continue
+            try:
+                ser = serialization.serialize(value)
+            except Exception as e:  # noqa: BLE001
+                out.append((oid.binary(), "error", pickle.dumps(TaskError(spec.name, e))))
+                continue
+            if ser.total_bytes <= GLOBAL_CONFIG.max_direct_call_object_size:
+                out.append((oid.binary(), "inline", ser.to_bytes()))
+            else:
+                size = self.core.shm.create_and_write(oid, ser)
+                self.core.io.run(
+                    self.core.daemon.call(
+                        "adopt_object", {"object_id": oid.binary(), "size": size}
+                    )
+                )
+                self.core.shm.release(oid)
+                out.append((oid.binary(), "shm", self.core._self_location()))
+        return out
+
+
+def _exit_now():
+    import os
+
+    os._exit(0)

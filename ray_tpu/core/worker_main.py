@@ -1,0 +1,60 @@
+"""Worker process entrypoint (spawned by the node daemon).
+
+Reference: the default worker main loop
+(``python/ray/_private/workers/default_worker.py`` + ``run_task_loop``
+``_raylet.pyx:3387``). The process builds a CoreWorker + TaskExecutor,
+registers with its node daemon using the spawn token, then parks — all
+work arrives over RPC on the io thread.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+
+def main() -> None:
+    import faulthandler
+
+    faulthandler.enable()
+    faulthandler.register(signal.SIGUSR2, all_threads=True)
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    token = os.environ["RAY_TPU_SPAWN_TOKEN"]
+    chost, cport = os.environ["RAY_TPU_CONTROLLER_ADDR"].rsplit(":", 1)
+    dhost, dport = os.environ["RAY_TPU_DAEMON_ADDR"].rsplit(":", 1)
+
+    from ray_tpu.core import api
+    from ray_tpu.core.core_worker import CoreWorker
+    from ray_tpu.core.ids import JobID
+    from ray_tpu.core.task_executor import TaskExecutor
+
+    executor = TaskExecutor()
+    core = CoreWorker(chost, int(cport), dhost, int(dport), executor=executor)
+    worker = api.Worker(api.Worker.MODE_WORKER, core, JobID.nil(), namespace="")
+    api.set_global_worker(worker)
+    executor.bind(core, worker)
+    # Bind fully BEFORE registering: the daemon may dispatch work (e.g.
+    # actor creation) the moment registration lands.
+    reply = core.io.run(
+        core.daemon.call(
+            "register_worker",
+            {"token": token, "host": core.host, "port": core.port},
+            retries=5,
+        )
+    )
+    core.finish_init(reply["node_id"])
+    worker.address = core.address
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,160 @@
+"""Cluster-mode (real multiprocess runtime) tests.
+
+Module-scoped cluster (reference pattern: shared ``ray_start_regular``
+fixtures) to amortize startup on slow CI machines.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import ActorDiedError, TaskError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_roundtrip(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+
+
+def test_parallel_tasks(cluster):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(8)]
+    assert ray_tpu.get(refs, timeout=120) == [i * i for i in range(8)]
+
+
+def test_large_object_via_shm(cluster):
+    arr = np.random.rand(400, 400)  # ~1.2MB > inline threshold
+    ref = ray_tpu.put(arr)
+    np.testing.assert_array_equal(ray_tpu.get(ref, timeout=60), arr)
+
+
+def test_large_task_arg_and_return(cluster):
+    @ray_tpu.remote
+    def echo(a):
+        return a * 2
+
+    arr = np.ones((500, 500))
+    out = ray_tpu.get(echo.remote(arr), timeout=120)
+    np.testing.assert_array_equal(out, arr * 2)
+
+
+def test_error_propagation(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise RuntimeError("cluster kaboom")
+
+    with pytest.raises(TaskError, match="cluster kaboom"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_nested_tasks(cluster):
+    @ray_tpu.remote
+    def leaf(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent():
+        return sum(ray_tpu.get([leaf.remote(i) for i in range(3)]))
+
+    assert ray_tpu.get(parent.remote(), timeout=120) == 6
+
+
+def test_actor_state_and_order(cluster):
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.values = []
+
+        def push(self, v):
+            self.values.append(v)
+            return len(self.values)
+
+        def get_all(self):
+            return self.values
+
+    a = Acc.remote()
+    for i in range(10):
+        a.push.remote(i)
+    assert ray_tpu.get(a.get_all.remote(), timeout=60) == list(range(10))
+
+
+def test_named_actor_and_kill(cluster):
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc1", num_cpus=0).remote()
+    h = ray_tpu.get_actor("svc1")
+    assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(h)
+    time.sleep(0.5)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(h.ping.remote(), timeout=60)
+
+
+def test_actor_creation_failure_surfaces(cluster):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("bad init")
+
+        def m(self):
+            return 1
+
+    b = Bad.options(num_cpus=0).remote()
+    with pytest.raises((ActorDiedError, TaskError)):
+        ray_tpu.get(b.m.remote(), timeout=60)
+
+
+def test_borrowed_ref_roundtrip(cluster):
+    @ray_tpu.remote
+    def producer():
+        return ray_tpu.put(list(range(100)))
+
+    inner = ray_tpu.get(producer.remote(), timeout=60)
+    assert ray_tpu.get(inner, timeout=60) == list(range(100))
+
+
+def test_wait_cluster(cluster):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    refs = [slow.remote(0.05), slow.remote(5.0)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=30)
+    assert ready == [refs[0]] and not_ready == [refs[1]]
+
+
+def test_async_actor(cluster):
+    @ray_tpu.remote
+    class A:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 10
+
+    a = A.options(max_concurrency=4, num_cpus=0).remote()
+    assert ray_tpu.get([a.work.remote(i) for i in range(4)], timeout=60) == [0, 10, 20, 30]
+
+
+def test_cluster_resources_reflect_usage(cluster):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0

@@ -1,0 +1,40 @@
+"""Fault tolerance: node death detection + actor restart on a new node."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_node_death_actor_restart():
+    cluster = Cluster(num_cpus=1)
+    n2 = cluster.add_node(num_cpus=1, resources={"pin": 1})
+    time.sleep(1.0)
+    ray_tpu.init(address=cluster.address)
+    try:
+
+        @ray_tpu.remote(max_restarts=1, resources={"pin": 1}, num_cpus=0)
+        class A:
+            def pid(self):
+                import os
+
+                return os.getpid()
+
+        a = A.remote()
+        pid1 = ray_tpu.get(a.pid.remote(), timeout=120)
+        cluster.remove_node(n2)
+        cluster.add_node(num_cpus=1, resources={"pin": 1})
+        deadline = time.time() + 90
+        pid2 = None
+        while time.time() < deadline:
+            try:
+                pid2 = ray_tpu.get(a.pid.remote(), timeout=15)
+                break
+            except ray_tpu.RayTpuError:
+                time.sleep(1)
+        assert pid2 is not None and pid2 != pid1
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

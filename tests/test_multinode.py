@@ -1,0 +1,52 @@
+"""Simulated multi-node tests (Cluster fixture, reference
+``cluster_utils.Cluster`` pattern): spillback scheduling, cross-node
+object transfer, node failure + actor restart."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def two_nodes():
+    cluster = Cluster(num_cpus=1)
+    n2 = cluster.add_node(num_cpus=2, resources={"special": 2})
+    time.sleep(1.0)
+    ray_tpu.init(address=cluster.address)
+    yield cluster, n2
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_resource_routing(two_nodes):
+    @ray_tpu.remote(resources={"special": 1})
+    def f():
+        return "on-special"
+
+    assert ray_tpu.get(f.remote(), timeout=120) == "on-special"
+
+
+def test_cross_node_transfer(two_nodes):
+    @ray_tpu.remote(resources={"special": 1})
+    def produce():
+        import numpy as np
+
+        return np.full((400, 400), 7.0)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(a):
+        return float(a.sum())
+
+    assert ray_tpu.get(consume.remote(produce.remote()), timeout=180) == 7.0 * 400 * 400
+
+
+def test_infeasible_task_fails(two_nodes):
+    @ray_tpu.remote(resources={"nonexistent": 1})
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(f.remote(), timeout=120)

@@ -1,0 +1,105 @@
+import numpy as np
+import pytest
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.ids import JobID, ObjectID, TaskID
+from ray_tpu.core.object_store import (
+    MemoryStore,
+    ObjectStoreFull,
+    ShmStore,
+    StoreClient,
+)
+
+
+def oid(i: int) -> ObjectID:
+    return ObjectID.for_put(TaskID.for_driver(JobID.from_index(1)), i)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ShmStore(capacity_bytes=10 * 1024 * 1024, spill_dir=str(tmp_path))
+    yield s
+    s.shutdown()
+
+
+def test_worker_create_daemon_adopt_read(store):
+    client = StoreClient()
+    arr = np.arange(10000, dtype=np.float64)
+    ser = serialization.serialize(arr)
+    o = oid(1)
+    size = client.create_and_write(o, ser)
+    store.adopt(o, size)
+
+    # another client attaches and reads zero-copy
+    reader = StoreClient()
+    meta = store.ensure_local(o)
+    assert meta is not None
+    name, sz = meta
+    buf = reader.read(o, sz)
+    out = serialization.deserialize_bytes(buf)
+    np.testing.assert_array_equal(out, arr)
+    client.close_all()
+    reader.close_all()
+
+
+def test_spill_and_restore(tmp_path):
+    store = ShmStore(capacity_bytes=1024 * 1024, spill_dir=str(tmp_path))
+    GLOBAL_CONFIG.object_spilling_threshold = 0.8
+    client = StoreClient()
+    objs = []
+    try:
+        # 5 x 300KB > 80% of 1MB -> forces spilling
+        for i in range(5):
+            arr = np.full(300 * 1024 // 8, i, dtype=np.float64)
+            ser = serialization.serialize(arr)
+            o = oid(i + 10)
+            size = client.create_and_write(o, ser)
+            store.adopt(o, size)
+            client.release(o)
+            objs.append((o, arr))
+        assert store.num_spilled > 0
+        # all objects still readable (restored transparently)
+        for o, arr in objs:
+            name, sz = store.ensure_local(o)
+            reader = StoreClient()
+            out = serialization.deserialize_bytes(reader.read(o, sz))
+            np.testing.assert_array_equal(out, arr)
+            reader.close_all()
+        assert store.num_restored > 0
+    finally:
+        client.close_all()
+        store.shutdown()
+
+
+def test_store_full(tmp_path):
+    store = ShmStore(capacity_bytes=1024, spill_dir=str(tmp_path))
+    with pytest.raises(ObjectStoreFull):
+        store.create_with_data(oid(99), memoryview(b"x" * 2048))
+    store.shutdown()
+
+
+def test_delete_frees_capacity(store):
+    o = oid(50)
+    store.create_with_data(o, memoryview(b"y" * 1000))
+    assert store.used_bytes == 1000
+    store.delete(o)
+    assert store.used_bytes == 0
+    assert store.ensure_local(o) is None
+
+
+def test_transfer_read_bytes(store):
+    o = oid(60)
+    payload = b"z" * 5000
+    store.create_with_data(o, memoryview(payload))
+    assert store.read_bytes(o) == payload
+
+
+def test_memory_store_wait():
+    import threading
+
+    ms = MemoryStore()
+    o = oid(70)
+    assert ms.wait_for(o, timeout=0.01) is None
+    threading.Timer(0.05, lambda: ms.put(o, b"data")).start()
+    assert ms.wait_for(o, timeout=2.0) == b"data"

@@ -1,0 +1,167 @@
+import asyncio
+
+import pytest
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.rpc import IoThread, RemoteError, RpcClient, RpcServer
+
+
+@pytest.fixture
+def io():
+    t = IoThread("test-io")
+    yield t
+    t.stop()
+
+
+def test_basic_call(io):
+    async def setup():
+        server = RpcServer()
+
+        async def echo(payload, ctx):
+            return ("echo", payload)
+
+        server.register("echo", echo)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    client = RpcClient("127.0.0.1", port)
+    assert io.run(client.call("echo", {"x": 1})) == ("echo", {"x": 1})
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_handler_error_propagates(io):
+    async def setup():
+        server = RpcServer()
+
+        async def bad(payload, ctx):
+            raise ValueError("server-side boom")
+
+        server.register("bad", bad)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    client = RpcClient("127.0.0.1", port)
+    with pytest.raises(ValueError, match="server-side boom"):
+        io.run(client.call("bad"))
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_concurrent_calls(io):
+    async def setup():
+        server = RpcServer()
+
+        async def slowecho(payload, ctx):
+            await asyncio.sleep(0.01)
+            return payload
+
+        server.register("echo", slowecho)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    client = RpcClient("127.0.0.1", port)
+
+    async def many():
+        return await asyncio.gather(*[client.call("echo", i) for i in range(50)])
+
+    assert io.run(many()) == list(range(50))
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_push_subscription(io):
+    received = []
+
+    async def setup():
+        server = RpcServer()
+
+        async def subscribe(payload, ctx):
+            ctx.peer_tags["chan"] = payload
+            asyncio.ensure_future(ctx.push(payload, {"msg": "hello"}))
+            return "subscribed"
+
+        server.register("subscribe", subscribe)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    client = RpcClient("127.0.0.1", port)
+    client.subscribe_push(7, lambda m: received.append(m))
+    assert io.run(client.call("subscribe", 7)) == "subscribed"
+    import time
+
+    for _ in range(100):
+        if received:
+            break
+        time.sleep(0.01)
+    assert received == [{"msg": "hello"}]
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_retry_reconnects(io):
+    """Client retries when server comes up late / restarts."""
+
+    async def setup():
+        server = RpcServer()
+
+        async def ping(payload, ctx):
+            return "pong"
+
+        server.register("ping", ping)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    client = RpcClient("127.0.0.1", port)
+    assert io.run(client.call("ping")) == "pong"
+    io.run(server.stop())
+    GLOBAL_CONFIG.rpc_connect_timeout_s = 0.5
+    try:
+        with pytest.raises(Exception):
+            io.run(client.call("ping", timeout=0.3))
+    finally:
+        GLOBAL_CONFIG.rpc_connect_timeout_s = 10.0
+
+    async def restart():
+        s2 = RpcServer(port=port)
+
+        async def ping(payload, ctx):
+            return "pong2"
+
+        s2.register("ping", ping)
+        await s2.start()
+        return s2
+
+    s2 = io.run(restart())
+    assert io.run(client.call("ping", retries=5)) == "pong2"
+    io.run(client.close())
+    io.run(s2.stop())
+
+
+def test_chaos_injection(io):
+    async def setup():
+        server = RpcServer()
+
+        async def ping(payload, ctx):
+            return "pong"
+
+        server.register("ping", ping)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    client = RpcClient("127.0.0.1", port)
+    GLOBAL_CONFIG.testing_rpc_failure = "ping:1.0"
+    try:
+        with pytest.raises(Exception, match="chaos"):
+            io.run(client.call("ping"))
+    finally:
+        GLOBAL_CONFIG.testing_rpc_failure = ""
+    assert io.run(client.call("ping")) == "pong"
+    io.run(client.close())
+    io.run(server.stop())
