@@ -181,7 +181,13 @@ class ActorClass:
             default_cpus=1.0,
         )
         spec.method_opts = _collect_method_opts(self._cls)
-        worker.backend.create_actor(spec)
+        try:
+            worker.backend.create_actor(spec)
+        except ValueError:
+            if opts.name and opts.get_if_exists:
+                # lost the name race — someone created it first
+                return get_actor(opts.name, opts.namespace)
+            raise
         return ActorHandle(
             actor_id,
             spec.method_opts,

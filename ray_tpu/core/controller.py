@@ -491,6 +491,24 @@ class Controller:
             "bundle_indices": [r.bundle_index for r in info.reservations],
         }
 
+    async def c_get_named_pg(self, payload, conn):
+        pg_id = self.named_pgs.get(payload["name"])
+        if pg_id is None:
+            return None
+        info = self.pgs.get(pg_id)
+        return {"pg_id": pg_id, "bundles": info.bundles, "state": info.state}
+
+    async def c_pg_table(self, payload, conn):
+        return {
+            pg_id.hex(): {
+                "state": info.state,
+                "bundles": info.bundles,
+                "strategy": info.strategy,
+                "name": info.name,
+            }
+            for pg_id, info in self.pgs.items()
+        }
+
     # ---- kv ------------------------------------------------------------
     async def c_kv_put(self, payload, conn):
         self.kv[payload["key"]] = payload["value"]

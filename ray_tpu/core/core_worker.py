@@ -674,6 +674,12 @@ class CoreWorker(RuntimeBackend):
     def get_pg(self, pg_id: bytes):
         return self.io.run(self.controller.call("get_pg", {"pg_id": pg_id}))
 
+    def get_named_pg(self, name: str):
+        return self.io.run(self.controller.call("get_named_pg", {"name": name}))
+
+    def pg_table(self):
+        return self.io.run(self.controller.call("pg_table"))
+
     # ------------------------------------------------------------------
     # kv / cluster info
     def kv_put(self, key: bytes, value: bytes) -> None:

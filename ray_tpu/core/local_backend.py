@@ -196,6 +196,42 @@ class LocalBackend(RuntimeBackend):
             ns = self._worker.namespace if self._worker else ""
             return [{"name": k[1], "namespace": k[0]} for k in self._named if k[0] == ns]
 
+    # ---- placement groups (trivially satisfied in local mode) ----------
+    def create_pg(self, pg_id: bytes, bundles, strategy: str, name: str = "") -> None:
+        with self._lock:
+            if not hasattr(self, "_pgs"):
+                self._pgs = {}
+                self._named_pgs = {}
+            self._pgs[pg_id] = {"state": "CREATED", "bundles": bundles, "strategy": strategy, "name": name}
+            if name:
+                self._named_pgs[name] = pg_id
+
+    def wait_pg_ready(self, pg_id: bytes, timeout) -> str:
+        with self._lock:
+            info = getattr(self, "_pgs", {}).get(pg_id)
+        return info["state"] if info else "REMOVED"
+
+    def remove_pg(self, pg_id: bytes) -> None:
+        with self._lock:
+            info = getattr(self, "_pgs", {}).get(pg_id)
+            if info:
+                info["state"] = "REMOVED"
+
+    def get_pg(self, pg_id: bytes):
+        with self._lock:
+            return getattr(self, "_pgs", {}).get(pg_id)
+
+    def get_named_pg(self, name: str):
+        with self._lock:
+            pg_id = getattr(self, "_named_pgs", {}).get(name)
+            if pg_id is None:
+                return None
+            return {"pg_id": pg_id, "bundles": self._pgs[pg_id]["bundles"], "state": self._pgs[pg_id]["state"]}
+
+    def pg_table(self):
+        with self._lock:
+            return {k.hex(): dict(v) for k, v in getattr(self, "_pgs", {}).items()}
+
     # ---- kv / cluster --------------------------------------------------
     def kv_put(self, key: bytes, value: bytes) -> None:
         with self._lock:

@@ -1,0 +1,12 @@
+"""TPU compute kernels (Pallas) + reference implementations."""
+
+from ray_tpu.ops.attention import flash_attention, reference_attention
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies
+
+__all__ = [
+    "flash_attention",
+    "reference_attention",
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+]

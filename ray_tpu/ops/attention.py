@@ -1,0 +1,325 @@
+"""Flash attention for TPU (Pallas).
+
+The hot op of the model stack: blockwise attention with online softmax so
+the S×S score matrix never materializes in HBM — O(S) memory, MXU-friendly
+block matmuls, fp32 accumulators with bf16-friendly inputs.
+
+Forward and backward are both Pallas kernels wired through
+``jax.custom_vjp`` (FlashAttention-2 style backward: saved logsumexp,
+D = rowsum(dO·O), split dq and dk/dv passes). On non-TPU backends the
+kernels run in interpreter mode so CI exercises the same code path
+(fake-ICI testing strategy, SURVEY §4.3).
+
+The reference stack has no equivalent op — attention lives inside torch
+models; this kernel is the TPU-native foundation the model zoo builds on.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK = 128
+_NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def reference_attention(q, k, v, *, causal: bool = True, sm_scale: Optional[float] = None):
+    """Pure-XLA attention (O(S^2) memory) — correctness oracle + fallback."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        qlen, klen = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, sm_scale: float):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, d]
+    block_q, d = q.shape
+    seq_k = k_ref.shape[1]
+    qb = pl.program_id(1)
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = seq_k // block_k
+    if causal:
+        # only blocks up to (and including) the diagonal contribute
+        upper = jax.lax.div((qb + 1) * block_q + block_k - 1, block_k)
+        upper = jnp.minimum(upper, num_kb)
+    else:
+        upper = num_kb
+
+    def body(kb, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    grid = (bh, seq_q // block_q)
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+    ]
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=out_shape,
+        interpret=_use_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (FlashAttention-2)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, causal, sm_scale):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # [block_q, 1]
+    delta = delta_ref[0]
+    block_q, d = q.shape
+    seq_k = k_ref.shape[1]
+    qb = pl.program_id(1)
+    num_kb = seq_k // block_k
+    if causal:
+        upper = jnp.minimum(jax.lax.div((qb + 1) * block_q + block_k - 1, block_k), num_kb)
+    else:
+        upper = num_kb
+
+    def body(kb, dq):
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q * sm_scale, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q, causal, sm_scale):
+    from jax.experimental import pallas as pl
+
+    kblk = k_ref[0].astype(jnp.float32)  # [bk, d]
+    vblk = v_ref[0].astype(jnp.float32)
+    block_k, d = kblk.shape
+    seq_q = q_ref.shape[1]
+    kb = pl.program_id(1)
+    num_qb = seq_q // block_q
+    if causal:
+        lower = jax.lax.div(kb * block_k, block_q)
+    else:
+        lower = 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q * sm_scale, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        lower, num_qb, body, (jnp.zeros((block_k, d), jnp.float32), jnp.zeros((block_k, d), jnp.float32))
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+    from jax.experimental import pallas as pl
+
+    q, k, v, o, lse = res
+    do = g
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # [bh, seq_q, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale),
+        grid=(bh, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal, sm_scale=sm_scale),
+        grid=(bh, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash3(q, k, v, causal, sm_scale, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash3_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash3_bwd(causal, sm_scale, block_q, block_k, res, g):
+    return _flash_bwd(causal, sm_scale, block_q, block_k, res, g)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+    impl: str = "auto",
+):
+    """Multi-head attention. q/k/v: ``[batch, heads, seq, head_dim]``.
+
+    ``impl``: "pallas" (flash kernel), "xla" (reference), or "auto"
+    (pallas on TPU, xla elsewhere — CI still covers the kernel through
+    interpret-mode tests). GQA: repeat kv heads before calling.
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl == "xla":
+        return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    b, h, seq_q, d = q.shape
+    seq_k = k.shape[2]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if seq_q % block_q or seq_k % block_k:
+        raise ValueError(
+            f"sequence lengths ({seq_q}, {seq_k}) must be divisible by "
+            f"block sizes ({block_q}, {block_k})"
+        )
+    qf = q.reshape(b * h, seq_q, d)
+    kf = k.reshape(b * h, seq_k, d)
+    vf = v.reshape(b * h, seq_k, d)
+    o = _flash3(qf, kf, vf, causal, sm_scale, block_q, block_k)
+    return o.reshape(b, h, seq_q, d)

@@ -1,0 +1,43 @@
+"""TPU parallelism layer: meshes, shardings, collectives.
+
+This is the first-class replacement for the reference's torch.distributed
+/ NCCL / ray.util.collective stack (SURVEY §2.4, §5.8): dense collectives
+happen *inside* compiled XLA programs over ICI; the runtime's job is gang
+placement and coordination. Cross-host/DCN data movement rides the object
+store.
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    DATA,
+    FSDP,
+    TENSOR,
+    SEQUENCE,
+    EXPERT,
+    STAGE,
+    cpu_mesh_devices,
+    make_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_to_sharding,
+    shard_params_fsdp,
+)
+from ray_tpu.parallel.collectives import CollectiveGroup, ObjectStoreCollectives
+
+__all__ = [
+    "MeshSpec",
+    "DATA",
+    "FSDP",
+    "TENSOR",
+    "SEQUENCE",
+    "EXPERT",
+    "STAGE",
+    "make_mesh",
+    "cpu_mesh_devices",
+    "ShardingRules",
+    "logical_to_sharding",
+    "shard_params_fsdp",
+    "CollectiveGroup",
+    "ObjectStoreCollectives",
+]

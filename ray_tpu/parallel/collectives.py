@@ -1,0 +1,224 @@
+"""Collective communication for actors.
+
+Reference: ``ray.util.collective`` (``collective.py:120,258,423,472,531,
+594``) with NCCL/GLOO backends. The TPU-native split (SURVEY §5.8):
+
+  * **ICI (primary)** — dense collectives happen inside compiled XLA
+    programs (``psum``/``all_gather``/``ppermute`` under pjit/shard_map);
+    nothing to do at runtime level beyond gang placement. See
+    ``ray_tpu.parallel.mesh``.
+  * **Host-level / DCN** — ``ObjectStoreCollectives``: rendezvous through
+    a coordinator actor + the distributed object store. This replaces the
+    reference's GLOO group for control-plane-sized tensors and works
+    between any actors anywhere (the GLOO-equivalent, not the NCCL path).
+
+API parity: init/allreduce/allgather/reducescatter/broadcast/send/recv/
+barrier.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_REDUCERS = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "prod": lambda arrs: np.prod(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "mean": lambda arrs: np.mean(arrs, axis=0),
+}
+
+
+class _Coordinator:
+    """Rendezvous actor: gathers per-rank contributions, serves results.
+
+    Async actor so all ranks' calls overlap (max_concurrency is set by the
+    creator to >= world_size).
+    """
+
+    def __init__(self, world_size: int):
+        import asyncio
+
+        self.world_size = world_size
+        self._ops: Dict[Any, Dict] = {}
+        self._mailbox: Dict[Any, Any] = {}
+        self._mailbox_events: Dict[Any, "asyncio.Event"] = {}
+
+    def _op(self, key):
+        import asyncio
+
+        op = self._ops.get(key)
+        if op is None:
+            op = self._ops[key] = {
+                "parts": {},
+                "event": asyncio.Event(),
+                "result": None,
+                "consumed": 0,
+            }
+        return op
+
+    async def contribute(self, key, rank: int, data, kind: str, extra=None):
+        """Submit rank's data; resolves once all ranks arrived."""
+        op = self._op(key)
+        op["parts"][rank] = data
+        if len(op["parts"]) == self.world_size:
+            parts = [op["parts"][r] for r in range(self.world_size)]
+            if kind == "allreduce":
+                op["result"] = _REDUCERS[extra](parts)
+            elif kind == "allgather":
+                op["result"] = parts
+            elif kind == "reducescatter":
+                reduced = _REDUCERS[extra](parts)
+                op["result"] = np.array_split(reduced, self.world_size)
+            elif kind == "broadcast":
+                op["result"] = op["parts"][extra]  # extra = root rank
+            elif kind == "barrier":
+                op["result"] = True
+            op["event"].set()
+        await op["event"].wait()
+        result = op["result"]
+        op["consumed"] += 1
+        if op["consumed"] == self.world_size:
+            del self._ops[key]
+        if kind == "reducescatter":
+            return result[rank]
+        return result
+
+    async def post(self, key, value):
+        import asyncio
+
+        self._mailbox[key] = value
+        ev = self._mailbox_events.get(key)
+        if ev is None:
+            ev = self._mailbox_events[key] = asyncio.Event()
+        ev.set()
+
+    async def take(self, key):
+        import asyncio
+
+        ev = self._mailbox_events.get(key)
+        if ev is None:
+            ev = self._mailbox_events[key] = asyncio.Event()
+        await ev.wait()
+        value = self._mailbox.pop(key)
+        del self._mailbox_events[key]
+        return value
+
+
+class CollectiveGroup:
+    """Handle used by each participating actor/process.
+
+    ``CollectiveGroup("g", world_size=4, rank=r)`` in every participant;
+    the named coordinator is created once (get_if_exists).
+    """
+
+    def __init__(self, name: str, world_size: int, rank: int):
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} out of range for world size {world_size}")
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        coordinator_cls = ray_tpu.remote(_Coordinator)
+        self._coord = coordinator_cls.options(
+            name=f"ray_tpu:collective:{name}",
+            get_if_exists=True,
+            num_cpus=0,
+            max_concurrency=max(2 * world_size, 8),
+            lifetime="detached",
+        ).remote(world_size)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def _next_key(self, kind: str):
+        with self._lock:
+            self._seq += 1
+            return (kind, self._seq)
+
+    # -- collectives -----------------------------------------------------
+    def allreduce(self, array, op: str = "sum"):
+        if op not in _REDUCERS:
+            raise ValueError(f"op must be one of {list(_REDUCERS)}")
+        key = self._next_key("ar")
+        return ray_tpu.get(
+            self._coord.contribute.remote(key, self.rank, np.asarray(array), "allreduce", op)
+        )
+
+    def allgather(self, array) -> List[np.ndarray]:
+        key = self._next_key("ag")
+        return ray_tpu.get(
+            self._coord.contribute.remote(key, self.rank, np.asarray(array), "allgather")
+        )
+
+    def reducescatter(self, array, op: str = "sum"):
+        key = self._next_key("rs")
+        return ray_tpu.get(
+            self._coord.contribute.remote(key, self.rank, np.asarray(array), "reducescatter", op)
+        )
+
+    def broadcast(self, array, root: int = 0):
+        key = self._next_key("bc")
+        data = np.asarray(array) if self.rank == root else None
+        return ray_tpu.get(
+            self._coord.contribute.remote(key, self.rank, data, "broadcast", root)
+        )
+
+    def barrier(self) -> None:
+        key = self._next_key("ba")
+        ray_tpu.get(self._coord.contribute.remote(key, self.rank, None, "barrier"))
+
+    # -- p2p -------------------------------------------------------------
+    def send(self, array, dst: int, tag: int = 0) -> None:
+        ray_tpu.get(self._coord.post.remote((self.rank, dst, tag), np.asarray(array)))
+
+    def recv(self, src: int, tag: int = 0):
+        return ray_tpu.get(self._coord.take.remote((src, self.rank, tag)))
+
+
+# Back-compat functional API (reference ``ray.util.collective``) ----------
+
+_groups: Dict[str, CollectiveGroup] = {}
+
+
+def init_collective_group(world_size: int, rank: int, backend: str = "objectstore", group_name: str = "default") -> CollectiveGroup:
+    group = CollectiveGroup(group_name, world_size, rank)
+    _groups[group_name] = group
+    return group
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    return _groups[group_name].allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _groups[group_name].allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    return _groups[group_name].reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _groups[group_name].broadcast(tensor, root=src_rank)
+
+
+def barrier(group_name: str = "default") -> None:
+    _groups[group_name].barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    _groups[group_name].send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _groups[group_name].recv(src_rank)
+
+
+class ObjectStoreCollectives:
+    """Alias namespace for discoverability."""
+
+    Group = CollectiveGroup

@@ -1,0 +1,103 @@
+"""Placement groups: gang reservation of resource bundles.
+
+Reference: ``python/ray/util/placement_group.py:41,145`` — a placement
+group atomically reserves N resource bundles across the cluster with a
+bundle policy (PACK / SPREAD / STRICT_PACK / STRICT_SPREAD, reference
+``bundle_scheduling_policy.h:82-106``); tasks/actors then target bundles
+via ``PlacementGroupSchedulingStrategy``.
+
+TPU-first: gang semantics are *the* TPU requirement — a partial slice is
+useless — so ``tpu_slice_bundles`` builds the canonical bundle list for an
+N-host pod slice (one ``TPU`` bundle per host plus the slice-head marker
+resource, cf. reference ``_private/accelerators/tpu.py`` pod-slice head).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.core.api import _global_worker
+from ray_tpu.core.exceptions import GetTimeoutError, RayTpuError
+from ray_tpu.core.ids import PlacementGroupID
+from ray_tpu.core.resources import tpu_slice_head_resource
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self.bundle_specs = bundles
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def ready(self, timeout: Optional[float] = None) -> "PlacementGroup":
+        """Block until all bundles are reserved (reference ``pg.ready()``)."""
+        state = _global_worker().backend.wait_pg_ready(self.id.binary(), timeout)
+        if state == "CREATED":
+            return self
+        if state == "INFEASIBLE":
+            raise RayTpuError(
+                f"placement group {self.id.hex()} is infeasible: no node set "
+                f"can host bundles {self.bundle_specs}"
+            )
+        raise GetTimeoutError(f"placement group {self.id.hex()} not ready (state={state})")
+
+    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        state = _global_worker().backend.wait_pg_ready(self.id.binary(), timeout_seconds)
+        return state == "CREATED"
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs))
+
+    def __repr__(self) -> str:
+        return f"PlacementGroup({self.id.hex()}, bundles={self.bundle_specs})"
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    for b in bundles:
+        if any(v < 0 for v in b.values()):
+            raise ValueError(f"negative resource in bundle {b}")
+    pg_id = PlacementGroupID.from_random()
+    worker = _global_worker()
+    worker.backend.create_pg(pg_id.binary(), [dict(b) for b in bundles], strategy, name)
+    return PlacementGroup(pg_id, [dict(b) for b in bundles])
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    _global_worker().backend.remove_pg(pg.id.binary())
+
+
+def get_placement_group(name: str) -> PlacementGroup:
+    info = _global_worker().backend.get_named_pg(name)
+    if info is None:
+        raise ValueError(f"no placement group named {name!r}")
+    return PlacementGroup(PlacementGroupID(info["pg_id"]), info["bundles"])
+
+
+def placement_group_table() -> Dict[str, Dict]:
+    return _global_worker().backend.pg_table()
+
+
+def tpu_slice_bundles(num_hosts: int, chips_per_host: int = 4, topology: str = "") -> List[Dict[str, float]]:
+    """Bundle list for gang-scheduling one pod slice: one bundle per host;
+    bundle 0 additionally claims the slice-head marker resource."""
+    bundles: List[Dict[str, float]] = []
+    for i in range(num_hosts):
+        b: Dict[str, float] = {"TPU": float(chips_per_host)}
+        if i == 0 and topology:
+            b[tpu_slice_head_resource(topology)] = 1.0
+        bundles.append(b)
+    return bundles

@@ -1,0 +1,41 @@
+"""User-facing scheduling strategies (reference
+``python/ray/util/scheduling_strategies.py``). These normalize to the
+internal ``task_spec`` strategy dataclasses at ``.options()`` time."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Union
+
+from ray_tpu.core.task_spec import (
+    NodeAffinityScheduling,
+    NodeLabelScheduling,
+    PlacementGroupScheduling,
+)
+
+
+def PlacementGroupSchedulingStrategy(
+    placement_group,
+    placement_group_bundle_index: int = -1,
+    placement_group_capture_child_tasks: bool = False,
+) -> PlacementGroupScheduling:
+    return PlacementGroupScheduling(
+        pg_id=placement_group.id.binary(),
+        bundle_index=placement_group_bundle_index,
+        capture_child_tasks=placement_group_capture_child_tasks,
+    )
+
+
+def NodeAffinitySchedulingStrategy(node_id: Union[str, bytes], soft: bool = False) -> NodeAffinityScheduling:
+    if isinstance(node_id, str):
+        node_id = bytes.fromhex(node_id)
+    return NodeAffinityScheduling(node_id=node_id, soft=soft)
+
+
+def NodeLabelSchedulingStrategy(
+    hard: Optional[Dict[str, Sequence[str]]] = None,
+    soft: Optional[Dict[str, Sequence[str]]] = None,
+) -> NodeLabelScheduling:
+    def norm(d):
+        return tuple((k, tuple(v)) for k, v in (d or {}).items())
+
+    return NodeLabelScheduling(hard=norm(hard), soft=norm(soft))

@@ -12,10 +12,20 @@ pytest imports it before any test module.
 
 import os
 
+# NOTE: a sitecustomize hook in this environment imports the axon TPU
+# plugin at interpreter startup, BEFORE this conftest runs — so setting
+# platform env vars here is too late for this process (they still matter
+# for subprocesses, which see them as real process env). For this process,
+# update the jax config directly before any backend initializes.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,101 @@
+"""Placement group API + gang scheduling tests."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.placement_group import tpu_slice_bundles
+
+
+def test_pg_validation(ray_start_local):
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": -1}])
+
+
+def test_tpu_slice_bundles():
+    bundles = tpu_slice_bundles(4, chips_per_host=4, topology="v4-32")
+    assert len(bundles) == 4
+    assert bundles[0]["TPU-v4-32-head"] == 1.0
+    assert all(b["TPU"] == 4.0 for b in bundles)
+
+
+@pytest.fixture(scope="module")
+def pg_cluster():
+    cluster = Cluster(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    time.sleep(1.0)
+    ray_tpu.init(address=cluster.address)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_pg_pack_and_schedule(pg_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK").ready(timeout=60)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import os
+
+        return os.getpid()
+
+    strat = PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    pid = ray_tpu.get(where.options(scheduling_strategy=strat).remote(), timeout=120)
+    assert pid > 0
+    remove_placement_group(pg)
+
+
+def test_pg_strict_spread(pg_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD").ready(timeout=60)
+
+    @ray_tpu.remote(num_cpus=1)
+    def node_of():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    strat0 = PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    strat1 = PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=1)
+    n0 = ray_tpu.get(node_of.options(scheduling_strategy=strat0).remote(), timeout=120)
+    n1 = ray_tpu.get(node_of.options(scheduling_strategy=strat1).remote(), timeout=120)
+    assert n0 != n1  # bundles on distinct nodes
+    remove_placement_group(pg)
+
+
+def test_pg_infeasible(pg_cluster):
+    pg = placement_group([{"CPU": 64}], strategy="STRICT_PACK")
+    with pytest.raises(ray_tpu.RayTpuError):
+        pg.ready(timeout=60)
+
+
+def test_pg_actor_placement(pg_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK").ready(timeout=60)
+
+    @ray_tpu.remote(num_cpus=1)
+    class Pinned:
+        def ping(self):
+            return "ok"
+
+    strat = PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    a = Pinned.options(scheduling_strategy=strat).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=120) == "ok"
+    ray_tpu.kill(a)
+    remove_placement_group(pg)
+
+
+def test_pg_table(pg_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="SPREAD", name="mypg").ready(timeout=60)
+    table = placement_group_table()
+    assert pg.id.hex() in table
+    assert table[pg.id.hex()]["name"] == "mypg"
+    remove_placement_group(pg)
