@@ -137,8 +137,15 @@ def timeline(filename: Optional[str] = None):
 
 
 def __getattr__(name: str):
-    # Lazy AI-library subpackages (keep `import ray_tpu` jax-free).
-    if name in ("data", "train", "tune", "serve", "rl", "parallel", "ops", "models", "util", "dag"):
+    # Lazy subpackages (keep `import ray_tpu` jax-free). Only packages that
+    # actually exist are advertised; new libraries are added as they land.
+    import importlib.util
+
+    if name in ("data", "train", "tune", "serve", "rl", "parallel", "ops", "models", "util", "dag", "observability"):
+        if importlib.util.find_spec(f"ray_tpu.{name}") is None:
+            raise AttributeError(
+                f"ray_tpu.{name} is not available in this build"
+            )
         import importlib
 
         return importlib.import_module(f"ray_tpu.{name}")

@@ -1,0 +1,63 @@
+"""Accelerator manager interface.
+
+Reference: ``python/ray/_private/accelerators/accelerator.py`` — a static
+interface per accelerator family used by the node daemon to autodetect
+resources and by the worker launch path to isolate devices per process.
+The TPU-native framework keeps the same shape but TPU is the first-class
+citizen (reference treats it as one of eight families).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+
+class AcceleratorManager(ABC):
+    """Per-family detection + isolation hooks (all static/class methods)."""
+
+    @staticmethod
+    @abstractmethod
+    def get_resource_name() -> str:
+        """Resource name this family contributes (e.g. ``"TPU"``)."""
+
+    @staticmethod
+    @abstractmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        """Env var used to restrict a process to specific devices."""
+
+    @staticmethod
+    @abstractmethod
+    def get_current_node_num_accelerators() -> int:
+        """Autodetect how many accelerators this host has (0 if none)."""
+
+    @staticmethod
+    @abstractmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        """Family-specific type string (e.g. ``"TPU-V4"``), or None."""
+
+    @staticmethod
+    def validate_resource_request_quantity(quantity: float) -> tuple:
+        """(ok, error_message) for a task/actor requesting ``quantity``."""
+        return True, None
+
+    @staticmethod
+    @abstractmethod
+    def set_current_process_visible_accelerator_ids(ids: List[str]) -> None:
+        """Restrict THIS process (and its children) to ``ids``."""
+
+    @staticmethod
+    @abstractmethod
+    def get_current_process_visible_accelerator_ids() -> Optional[List[str]]:
+        """Currently-visible device ids, or None when unrestricted."""
+
+    @staticmethod
+    def get_additional_node_resources() -> dict:
+        """Extra resources this family contributes on registration
+        (e.g. TPU slice-head gang resources)."""
+        return {}
+
+    @staticmethod
+    def get_additional_node_labels() -> dict:
+        """Node labels contributed on registration."""
+        return {}

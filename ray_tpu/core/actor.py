@@ -45,7 +45,12 @@ class ActorMethod:
         return ActorMethod(self._handle, self._name, merged)
 
     def bind(self, *args, **kwargs):
-        from ray_tpu.dag.node import ActorMethodNode
+        try:
+            from ray_tpu.dag.node import ActorMethodNode
+        except ImportError as e:
+            raise NotImplementedError(
+                "ray_tpu.dag (compiled graphs) is not available in this build"
+            ) from e
 
         return ActorMethodNode(self._handle, self._name, args, kwargs, self._opts)
 
@@ -197,7 +202,12 @@ class ActorClass:
         )
 
     def bind(self, *args, **kwargs):
-        from ray_tpu.dag.node import ActorClassNode
+        try:
+            from ray_tpu.dag.node import ActorClassNode
+        except ImportError as e:
+            raise NotImplementedError(
+                "ray_tpu.dag (compiled graphs) is not available in this build"
+            ) from e
 
         return ActorClassNode(self, args, kwargs)
 

@@ -15,6 +15,7 @@ Two backends implement ``RuntimeBackend``:
 from __future__ import annotations
 
 import atexit
+import contextvars
 import threading
 import time
 from abc import ABC, abstractmethod
@@ -73,6 +74,9 @@ class RuntimeBackend(ABC):
     @abstractmethod
     def kv_get(self, key: bytes) -> Optional[bytes]: ...
 
+    def kv_keys(self, prefix: bytes = b"") -> List[bytes]:
+        return []
+
     @abstractmethod
     def free(self, object_ids: Sequence[ObjectID]) -> None: ...
 
@@ -116,8 +120,6 @@ class Worker:
         self.namespace = namespace
         self.worker_id = WorkerID.from_random()
         self.address: Optional[Address] = None  # set by cluster runtime
-        # Task context: the "current task" owns puts/submissions made here.
-        self._context = threading.local()
         self._put_counter = 0
         self._task_counter = 0
         self._lock = threading.Lock()
@@ -125,16 +127,28 @@ class Worker:
         set_refcount_hooks(self._on_ref_created, self._on_ref_deleted, self._on_ref_borrowed)
 
     # ---- task context --------------------------------------------------
+    # A ContextVar (not threading.local) so the context is correct both on
+    # lane threads AND per-coroutine on the async-actor lane — each asyncio
+    # task carries its own copy, so concurrent async methods can't cross
+    # puts into each other's ObjectID namespace. Entries are job-scoped:
+    # a cached driver TaskID from a previous init()/shutdown() cycle (the
+    # ContextVar is module-level and outlives the Worker) must not leak
+    # into a new job's ObjectID namespace.
     @property
     def current_task_id(self) -> TaskID:
-        tid = getattr(self._context, "task_id", None)
-        if tid is None:
+        entry = _current_task_id.get()
+        # Auto-created driver entries are invalidated when the job changed
+        # (a module-level ContextVar outlives init()/shutdown() cycles);
+        # executor-set entries carry their own job and are always valid —
+        # the shared self.job_id attr must not leak across concurrent tasks.
+        if entry is None or (entry[2] and entry[0] != self.job_id):
             tid = TaskID.for_driver(self.job_id)
-            self._context.task_id = tid
-        return tid
+            _current_task_id.set((self.job_id, tid, True))
+            return tid
+        return entry[1]
 
-    def set_task_context(self, task_id: TaskID) -> None:
-        self._context.task_id = task_id
+    def set_task_context(self, task_id: TaskID, job_id: Optional[JobID] = None) -> None:
+        _current_task_id.set((job_id or self.job_id, task_id, False))
 
     # ---- refcounting hooks --------------------------------------------
     def _on_ref_created(self, ref: ObjectRef) -> None:
@@ -202,6 +216,8 @@ class Worker:
             if isinstance(a, ObjectRef):
                 sargs.append(("ref", a))
                 continue
+            if callable(a):
+                serialization.ensure_importable_or_by_value(a)
             ser = serialization.serialize(a)
             if ser.total_bytes <= threshold and not ser.contained_refs:
                 sargs.append(("val", ser.to_bytes()))
@@ -213,6 +229,8 @@ class Worker:
             if isinstance(a, ObjectRef):
                 skwargs.append(("ref", k, a))
                 continue
+            if callable(a):
+                serialization.ensure_importable_or_by_value(a)
             ser = serialization.serialize(a)
             if ser.total_bytes <= threshold and not ser.contained_refs:
                 skwargs.append(("val", k, ser.to_bytes()))
@@ -321,6 +339,11 @@ class Worker:
     def shutdown(self) -> None:
         set_refcount_hooks(None, None, None)
         self.backend.shutdown()
+
+
+_current_task_id: contextvars.ContextVar[Optional[Tuple[JobID, TaskID]]] = (
+    contextvars.ContextVar("ray_tpu_current_task_id", default=None)
+)
 
 
 # --- global worker singleton -------------------------------------------

@@ -688,6 +688,9 @@ class CoreWorker(RuntimeBackend):
     def kv_get(self, key: bytes) -> Optional[bytes]:
         return self.io.run(self.controller.call("kv_get", {"key": key}))
 
+    def kv_keys(self, prefix: bytes = b"") -> List[bytes]:
+        return self.io.run(self.controller.call("kv_keys", {"prefix": prefix}))
+
     def cluster_resources(self) -> Dict[str, float]:
         return self.io.run(self.controller.call("cluster_resources"))
 
@@ -737,6 +740,19 @@ class CoreWorker(RuntimeBackend):
 
     async def w_ping(self, payload, conn):
         return "pong"
+
+    async def w_set_accelerator_env(self, payload, conn):
+        """Daemon-assigned device isolation for pooled workers (dedicated
+        actor workers get it via spawn env). Effective as long as the
+        accelerator runtime hasn't initialized in this process yet."""
+        from ray_tpu.accelerators import get_accelerator_manager
+
+        mgr = get_accelerator_manager(payload["resource"])
+        if mgr is not None:
+            ids = payload.get("ids")
+            if ids:
+                mgr.set_current_process_visible_accelerator_ids([str(i) for i in ids])
+        return True
 
     # execution services are registered when an executor is attached
     async def w_push_task(self, payload, conn):

@@ -30,6 +30,9 @@ class FunctionTable:
 
     def export(self, obj: Any) -> bytes:
         """Pickle `obj` (function or class), store under its hash, return id."""
+        from ray_tpu.core.serialization import ensure_importable_or_by_value
+
+        ensure_importable_or_by_value(obj)
         payload = cloudpickle.dumps(obj)
         function_id = hashlib.sha256(payload).digest()[:16]
         with self._lock:

@@ -241,6 +241,10 @@ class LocalBackend(RuntimeBackend):
         with self._lock:
             return self._kv.get(key)
 
+    def kv_keys(self, prefix: bytes = b"") -> List[bytes]:
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
     def cluster_resources(self) -> Dict[str, float]:
         return dict(self._resources)
 

@@ -61,6 +61,7 @@ class WorkerProc:
     # resources held by a dedicated actor worker, released on its death
     actor_resources: Optional[Dict[str, float]] = None
     actor_bundle_key: Optional[Tuple[bytes, int]] = None
+    tpu_chips: Optional[List[int]] = None  # chip ids assigned to this worker
     conn: Optional[ServerConnection] = None
     client: Optional[RpcClient] = None
 
@@ -71,6 +72,7 @@ class Lease:
     resources: Dict[str, float]
     worker: WorkerProc
     bundle_key: Optional[Tuple[bytes, int]] = None
+    tpu_chips: Optional[List[int]] = None
 
 
 @dataclass
@@ -102,7 +104,24 @@ class NodeDaemon:
         self.controller_addr = (controller_host, controller_port)
         res = dict(resources or {})
         res.setdefault("CPU", float(os.cpu_count() or 1))
-        self.resources = NodeResources(ResourceSet(res), labels=labels)
+        merged_labels = dict(labels or {})
+        # Accelerator autodetection (reference: raylet consults the
+        # accelerator registry at startup). Explicit user resources win.
+        if "TPU" not in res:
+            try:
+                from ray_tpu.accelerators import detect_node_accelerators
+
+                auto_res, auto_labels = detect_node_accelerators()
+                for k, v in auto_res.items():
+                    res.setdefault(k, v)
+                for k, v in auto_labels.items():
+                    merged_labels.setdefault(k, v)
+            except Exception:
+                logger.debug("accelerator autodetection failed", exc_info=True)
+        self.resources = NodeResources(ResourceSet(res), labels=merged_labels or None)
+        # Node-wide TPU chip-id pool: every worker holding TPU resources
+        # gets concrete chip ids (TPU_VISIBLE_CHIPS isolation).
+        self._tpu_chips_free: List[int] = list(range(int(res.get("TPU", 0))))
         self.store = ShmStore()
         self.session_dir = session_dir or f"/tmp/ray_tpu/session_{os.getpid()}"
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
@@ -191,8 +210,31 @@ class NodeDaemon:
                     logger.debug("resource sync failed", exc_info=True)
             await asyncio.sleep(0.2)
 
+    # ---- TPU chip-id pool ----------------------------------------------
+    def _allocate_tpu_chips(self, n: int) -> Optional[List[int]]:
+        if n <= 0:
+            return None
+        if len(self._tpu_chips_free) < n:
+            logger.warning(
+                "TPU accounting says %d chips free but id pool has %d",
+                n, len(self._tpu_chips_free),
+            )
+            return None
+        chips = self._tpu_chips_free[:n]
+        del self._tpu_chips_free[:n]
+        return chips
+
+    def _free_tpu_chips(self, chips: Optional[List[int]]) -> None:
+        if chips:
+            self._tpu_chips_free.extend(chips)
+            self._tpu_chips_free.sort()
+
     # ---- worker pool ---------------------------------------------------
-    def _spawn_worker(self, actor_spec: Optional[TaskSpec] = None) -> WorkerProc:
+    def _spawn_worker(
+        self,
+        actor_spec: Optional[TaskSpec] = None,
+        tpu_chips: Optional[List[int]] = None,
+    ) -> WorkerProc:
         token = os.urandom(8).hex()
         log_path = os.path.join(self.session_dir, "logs", f"worker-{token}.log")
         log_f = open(log_path, "ab")
@@ -202,6 +244,14 @@ class NodeDaemon:
         env["RAY_TPU_DAEMON_ADDR"] = f"{self.host}:{self.port}"
         env["RAY_TPU_CONTROLLER_ADDR"] = f"{self.controller_addr[0]}:{self.controller_addr[1]}"
         env.pop("JAX_PLATFORMS", None)  # workers decide their own platform
+        # Dedicated actor workers get their chip isolation at spawn time —
+        # before libtpu can initialize (TPU_VISIBLE_CHIPS + topology bounds,
+        # reference accelerators/tpu.py:31).
+        chips = tpu_chips
+        if chips is not None:
+            from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+            env.update(TPUAcceleratorManager.isolation_env([str(c) for c in chips]))
         # Workers share the daemon's process group so a hard node kill
         # (killpg, cluster_utils.remove_node) takes them down too.
         proc = subprocess.Popen(
@@ -211,6 +261,7 @@ class NodeDaemon:
             stderr=subprocess.STDOUT,
         )
         w = WorkerProc(pid=proc.pid, proc=proc, token=token)
+        w.tpu_chips = chips
         self.workers[token] = w
         if actor_spec is not None:
             w.actor_id = actor_spec.actor_id
@@ -316,6 +367,36 @@ class NodeDaemon:
         worker.leased = True
         self._lease_counter += 1
         lease = Lease(self._lease_counter, request, worker, bundle_key)
+        # TPU isolation for pooled workers: assign chip ids and tell the
+        # worker before any task lands on it. A worker that holds chips is
+        # chip-BOUND for its lifetime (libtpu can't rebind after init), so
+        # it is retired — not pooled — when the lease ends; failure to
+        # isolate fails the lease rather than granting an unisolated one.
+        if request.get("TPU", 0) >= 1 and worker.tpu_chips is None:
+            chips = self._allocate_tpu_chips(int(request["TPU"]))
+            ok = False
+            if chips is not None and worker.client is not None:
+                try:
+                    await worker.client.call(
+                        "set_accelerator_env",
+                        {"resource": "TPU", "ids": chips},
+                        timeout=5,
+                    )
+                    ok = True
+                except Exception:
+                    logger.warning("set_accelerator_env failed", exc_info=True)
+            if not ok:
+                self._free_tpu_chips(chips)
+                worker.leased = False
+                if worker not in self.idle:
+                    self.idle.append(worker)
+                if bundle_key is not None:
+                    self._bundle_pools[bundle_key].release(ResourceSet(request))
+                else:
+                    self.resources.release(ResourceSet(request))
+                return {"retry_after": 0.1}
+            worker.tpu_chips = chips
+            lease.tpu_chips = chips
         self.leases[lease.lease_id] = lease
         return {
             "grant": {
@@ -401,6 +482,15 @@ class NodeDaemon:
             self.resources.release(req)
         w = lease.worker
         w.leased = False
+        if w.tpu_chips is not None and w.actor_id is None:
+            # Chip-bound pooled worker: libtpu is (possibly) initialized on
+            # these chips, so the process can never serve a different chip
+            # set. Retire it; the reap loop frees its chips.
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+            return
         if w.proc.poll() is None and w.registered and w.actor_id is None and w not in self.idle:
             self.idle.append(w)
 
@@ -418,12 +508,26 @@ class NodeDaemon:
             if not self.resources.can_fit(req):
                 raise RuntimeError("insufficient resources for actor")
             self.resources.allocate(req)
-        w = self._spawn_worker(actor_spec=spec)
+        # Chip isolation is mandatory for TPU actors: failing the creation
+        # (controller reschedules) beats spawning an unisolated process
+        # that would grab every chip on the host.
+        chips = None
+        if spec.resources.get("TPU", 0) >= 1:
+            chips = self._allocate_tpu_chips(int(spec.resources["TPU"]))
+            if chips is None:
+                if bundle_key is not None:
+                    self._bundle_pools[bundle_key].release(req)
+                else:
+                    self.resources.release(req)
+                raise RuntimeError("TPU chip ids unavailable (pool exhausted)")
+        w = self._spawn_worker(actor_spec=spec, tpu_chips=chips)
         w.actor_resources = dict(spec.resources)
         w.actor_bundle_key = bundle_key
         return {"pid": w.pid}
 
     def _release_actor_resources(self, w: WorkerProc) -> None:
+        self._free_tpu_chips(w.tpu_chips)
+        w.tpu_chips = None
         if w.actor_resources is None:
             return
         req = ResourceSet(w.actor_resources)

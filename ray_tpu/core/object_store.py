@@ -289,17 +289,40 @@ class StoreClient:
     def create_and_write(self, object_id: ObjectID, ser) -> int:
         """Write a SerializedValue into a fresh segment; returns size."""
         size = ser.total_bytes
+        buf = bytearray()
+        ser.write_into(buf)
         try:
             seg = _create(segment_name(object_id), size)
         except FileExistsError:
-            # Same object re-produced (task retry / simulated multi-node):
-            # content is identical by construction — overwrite in place.
+            # Same object re-produced (task retry / simulated multi-node).
+            # Re-serialization (cloudpickle) is not guaranteed byte-identical:
+            # if the new payload is larger than the old segment, unlink and
+            # recreate — POSIX unlink keeps existing readers' mappings valid.
             seg = _attach(segment_name(object_id))
-        buf = bytearray()
-        ser.write_into(buf)
+            if len(seg.buf) < len(buf):
+                try:
+                    seg.unlink()
+                finally:
+                    seg.close()
+                seg = _create(segment_name(object_id), size)
         seg.buf[: len(buf)] = buf
         with self._lock:
+            # Drop stale cached mappings (both caches): after a re-produce
+            # the old unlinked inode must not win future read()s.
+            stale = [
+                s
+                for s in (
+                    self._created.pop(object_id, None),
+                    self._attached.pop(object_id, None),
+                )
+                if s is not None and s is not seg
+            ]
             self._created[object_id] = seg
+        for s in stale:
+            try:
+                s.close()
+            except Exception:
+                pass
         return size
 
     def read(self, object_id: ObjectID, size: int) -> memoryview:

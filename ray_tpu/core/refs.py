@@ -37,11 +37,24 @@ class Address:
 class ObjectRef:
     __slots__ = ("_id", "_owner", "_skip_refcount", "__weakref__")
 
-    def __init__(self, object_id: ObjectID, owner: Optional[Address] = None, *, _skip_refcount: bool = False):
+    def __init__(
+        self,
+        object_id: ObjectID,
+        owner: Optional[Address] = None,
+        *,
+        _skip_refcount: bool = False,
+        _borrowed: bool = False,
+    ):
         self._id = object_id
         self._owner = owner
+        # _borrowed refs register with the owner as borrowers on creation
+        # and STILL deregister on __del__ (remove_local_ref routes to
+        # remove_borrower for non-owned ids) — a deserialized ref must
+        # participate in lifecycle or the owner pins the object forever.
         self._skip_refcount = _skip_refcount
-        if not _skip_refcount:
+        if _borrowed:
+            _runtime_register_borrow(self)
+        elif not _skip_refcount:
             _runtime_add_local_ref(self)
 
     # -- identity --------------------------------------------------------
@@ -90,9 +103,7 @@ class ObjectRef:
 
 
 def _deserialize_ref(binary: bytes, owner: Optional[Address]) -> ObjectRef:
-    ref = ObjectRef(ObjectID(binary), owner, _skip_refcount=True)
-    _runtime_register_borrow(ref)
-    return ref
+    return ObjectRef(ObjectID(binary), owner, _borrowed=True)
 
 
 # --- hooks into the ambient runtime (set by api.init) -------------------

@@ -32,7 +32,12 @@ class RemoteFunction:
 
     def bind(self, *args, **kwargs):
         """DAG-node construction (compiled graphs)."""
-        from ray_tpu.dag.node import FunctionNode
+        try:
+            from ray_tpu.dag.node import FunctionNode
+        except ImportError as e:
+            raise NotImplementedError(
+                "ray_tpu.dag (compiled graphs) is not available in this build"
+            ) from e
 
         return FunctionNode(self, args, kwargs)
 

@@ -92,6 +92,88 @@ def _find_custom(obj: Any) -> Optional[Tuple[Type, Tuple[Callable, Callable]]]:
     return None
 
 
+# --- by-value pickling for driver-script modules -------------------------
+#
+# cloudpickle pickles module-level functions BY REFERENCE when their module
+# is importable in the pickling process — but a driver script / test module
+# sitting outside the worker's import path (reference: shipped via
+# runtime_env working_dir) can't be imported there. Modules whose file is
+# not reachable from the import roots workers inherit (site-packages, the
+# ray_tpu package root, PYTHONPATH, cwd) are registered for by-value
+# pickling, so their functions travel like ``__main__`` functions do.
+
+_by_value_checked: set = set()
+_worker_roots_cache: Optional[List[str]] = None
+
+
+def _worker_import_roots() -> List[str]:
+    """The import roots a worker subprocess will actually have: a pristine
+    interpreter's sys.path (captured once via a subprocess, so .pth-mapped
+    editable installs are included) + the ray_tpu package root + PYTHONPATH
+    + cwd. Driver-only insertions (pytest rootdir, sys.path.insert in the
+    driver script) are deliberately absent."""
+    global _worker_roots_cache
+    if _worker_roots_cache is not None:
+        return _worker_roots_cache
+    import os
+    import subprocess
+    import sys
+
+    roots = set()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-I", "-c", "import sys, json; print(json.dumps(sys.path))"],
+            capture_output=True, timeout=20,
+        )
+        import json
+
+        roots.update(p for p in json.loads(out.stdout) if p)
+    except Exception:
+        import sysconfig
+
+        for key in ("purelib", "platlib", "stdlib", "platstdlib"):
+            try:
+                roots.add(sysconfig.get_paths()[key])
+            except KeyError:
+                pass
+    import ray_tpu
+
+    roots.add(os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__))))
+    for p in os.environ.get("PYTHONPATH", "").split(os.pathsep):
+        if p:
+            roots.add(os.path.abspath(p))
+    roots.add(os.getcwd())
+    _worker_roots_cache = [os.path.abspath(r) for r in roots]
+    return _worker_roots_cache
+
+
+def ensure_importable_or_by_value(obj: Any) -> None:
+    """If ``obj``'s defining module can't be imported on workers, register
+    it with cloudpickle for by-value pickling (idempotent, cheap)."""
+    import os
+    import sys
+
+    mod_name = getattr(obj, "__module__", None)
+    if not mod_name or mod_name == "__main__" or mod_name in _by_value_checked:
+        return
+    _by_value_checked.add(mod_name)
+    mod = sys.modules.get(mod_name)
+    if mod is None or getattr(mod, "__file__", None) is None:
+        return
+    # Importable on a worker iff ``import <mod_name>`` resolves from one of
+    # the worker's import roots — i.e. the name-derived path exists there.
+    rel = mod_name.replace(".", os.sep)
+    for root in _worker_import_roots():
+        if os.path.exists(os.path.join(root, rel + ".py")) or os.path.exists(
+            os.path.join(root, rel, "__init__.py")
+        ):
+            return  # keep by-reference pickling
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+    except Exception:
+        pass
+
+
 def serialize(value: Any) -> SerializedValue:
     from ray_tpu.core.refs import ObjectRef  # cycle: refs uses serialization
 

@@ -80,7 +80,7 @@ class TaskExecutor:
 
         def _create():
             self.api_worker.job_id = spec.job_id
-            self.api_worker.set_task_context(spec.task_id)
+            self.api_worker.set_task_context(spec.task_id, spec.job_id)
             cls = self.api_worker.fn_table.load(spec.function_id)
             args, kwargs = execution.resolve_args(spec, self._get_dep)
             self._actor_instance = cls(*args, **kwargs)
@@ -181,6 +181,13 @@ class TaskExecutor:
         )
 
         async def _run():
+            # Per-coroutine task context (ContextVar) so puts made inside the
+            # async method derive ObjectIDs from THIS task's id, not the
+            # deterministic driver id — two async actors in one job would
+            # otherwise mint colliding ObjectIDs (shm segments are named by
+            # ObjectID, so a collision silently overwrites data).
+            self.api_worker.job_id = spec.job_id
+            self.api_worker.set_task_context(spec.task_id, spec.job_id)
             if self._async_sem is None:
                 self._async_sem = asyncio.Semaphore(max(1, self._max_concurrency))
             async with self._async_sem:
@@ -200,8 +207,23 @@ class TaskExecutor:
     # ------------------------------------------------------------------
     def _execute(self, spec: TaskSpec) -> List[Tuple[bytes, str, Any]]:
         """Runs on a lane thread. Returns packaged results."""
+        from ray_tpu.observability import timeline as _timeline
+
+        _start_us = _timeline._now_us()
+        try:
+            return self._execute_inner(spec)
+        finally:
+            _timeline.record_event(
+                f"task::{spec.name}",
+                "task",
+                _start_us,
+                _timeline._now_us(),
+                args={"task_id": spec.task_id.hex()[:16]},
+            )
+
+    def _execute_inner(self, spec: TaskSpec) -> List[Tuple[bytes, str, Any]]:
         self.api_worker.job_id = spec.job_id
-        self.api_worker.set_task_context(spec.task_id)
+        self.api_worker.set_task_context(spec.task_id, spec.job_id)
         try:
             if spec.kind == TaskKind.ACTOR_TASK:
                 fn = getattr(self._actor_instance, spec.method_name)

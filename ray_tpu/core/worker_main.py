@@ -50,6 +50,10 @@ def main() -> None:
     core.finish_init(reply["node_id"])
     worker.address = core.address
 
+    from ray_tpu.observability.timeline import start_export_thread
+
+    start_export_thread()
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     stop.wait()

@@ -1,0 +1,30 @@
+"""ray_tpu.models — the TPU-native model zoo.
+
+The reference keeps models inside libraries (RLlib catalogs, Train
+examples); here the flagship LM family is first-class so Train/Serve/RL
+and the benchmarks share one implementation.
+"""
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    init_params,
+    init_sharded,
+    logical_axes,
+    make_train_step,
+    next_token_loss,
+    param_count,
+    param_shardings,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "forward",
+    "init_params",
+    "init_sharded",
+    "logical_axes",
+    "make_train_step",
+    "next_token_loss",
+    "param_count",
+    "param_shardings",
+]

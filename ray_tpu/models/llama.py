@@ -1,0 +1,281 @@
+"""Llama-family decoder LM, TPU-native (pure JAX + pallas flash attention).
+
+The flagship model path (SURVEY §7 step 7 north star). Design:
+  * pure-function model — params are a plain dict pytree; no flax Module
+    state to fight GSPMD;
+  * every parameter has *logical* axis names (``logical_axes``); a
+    ``ShardingRules`` table (``ray_tpu.parallel.sharding``) maps them to
+    mesh axes, so DP/FSDP/TP/SP re-parallelization is a table swap;
+  * attention is ``ray_tpu.ops.flash_attention`` (pallas on TPU, XLA
+    fallback elsewhere), GQA via KV-head repeat;
+  * bf16-friendly: matmuls in the param dtype, softmax/logits/loss in
+    fp32 (MXU wants bf16 inputs + f32 accumulation).
+
+The reference has no JAX model zoo (torch-only, e.g. RLlib models and
+Train examples); this is build-new per SURVEY §2.4.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_hidden: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    #: attention impl: "auto" | "pallas" | "xla" | "ring" (seq-parallel)
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama2_7b(**overrides) -> "LlamaConfig":
+        base = dict(
+            vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=32, mlp_hidden=11008, max_seq_len=4096,
+            dtype=jnp.bfloat16,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        """CI-sized config (dryrun / unit tests)."""
+        base = dict(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            mlp_hidden=128, max_seq_len=64,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# params + logical sharding axes
+
+
+def _layer_shapes(cfg: LlamaConfig) -> Dict[str, Tuple[int, ...]]:
+    hd = cfg.head_dim
+    return {
+        "attn_norm": (cfg.dim,),
+        "wq": (cfg.dim, cfg.n_heads, hd),
+        "wk": (cfg.dim, cfg.n_kv_heads, hd),
+        "wv": (cfg.dim, cfg.n_kv_heads, hd),
+        "wo": (cfg.n_heads, hd, cfg.dim),
+        "mlp_norm": (cfg.dim,),
+        "w_gate": (cfg.dim, cfg.mlp_hidden),
+        "w_up": (cfg.dim, cfg.mlp_hidden),
+        "w_down": (cfg.mlp_hidden, cfg.dim),
+    }
+
+
+def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Pytree (same structure as params) of logical-axis-name tuples."""
+    layer = {
+        "attn_norm": (None,),
+        "wq": ("embed", "heads", "head_dim"),
+        "wk": ("embed", "kv_heads", "head_dim"),
+        "wv": ("embed", "kv_heads", "head_dim"),
+        "wo": ("heads", "head_dim", "embed"),
+        "mlp_norm": (None,),
+        "w_gate": ("embed", "mlp"),
+        "w_up": ("embed", "mlp"),
+        "w_down": ("mlp", "embed"),
+    }
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(cfg: LlamaConfig, rng: jax.Array) -> Dict[str, Any]:
+    keys = jax.random.split(rng, cfg.n_layers + 2)
+
+    def dense(key, shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    def layer(key):
+        shapes = _layer_shapes(cfg)
+        ks = jax.random.split(key, len(shapes))
+        out = {}
+        for (name, shape), k in zip(shapes.items(), ks):
+            if name.endswith("norm"):
+                out[name] = jnp.ones(shape, cfg.dtype)
+            else:
+                out[name] = dense(k, shape, shape[0] if len(shape) == 2 else cfg.dim)
+        return out
+
+    return {
+        "embed": dense(keys[0], (cfg.vocab_size, cfg.dim), cfg.dim),
+        "layers": [layer(keys[i + 1]) for i in range(cfg.n_layers)],
+        "final_norm": jnp.ones((cfg.dim,), cfg.dtype),
+        "lm_head": dense(keys[-1], (cfg.dim, cfg.vocab_size), cfg.dim),
+    }
+
+
+def param_count(cfg: LlamaConfig) -> int:
+    shapes = list(_layer_shapes(cfg).values())
+    per_layer = sum(math.prod(s) for s in shapes)
+    return (
+        cfg.vocab_size * cfg.dim * 2  # embed + lm_head
+        + per_layer * cfg.n_layers
+        + cfg.dim
+    )
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def rms_norm(x, weight, eps: float):
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * inv).astype(x.dtype) * weight
+
+
+def rope_tables(cfg: LlamaConfig, seq_len: int, offset: int = 0):
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, jnp.float32) / hd))
+    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    ang = jnp.outer(pos, inv_freq)  # [S, hd/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, S, H, hd] — rotate pairs (even, odd)."""
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    out1 = x1 * c - x2 * s
+    out2 = x2 * c + x1 * s
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _attention_block(cfg: LlamaConfig, p, x, cos, sin):
+    B, S, _ = x.shape
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # [B, S, H, hd] → [B, H, S, hd]
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    if cfg.attention_impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        o = ring_attention(qt, kt, vt, causal=True)
+    else:
+        o = flash_attention(qt, kt, vt, causal=True, impl=cfg.attention_impl)
+    o = o.transpose(0, 2, 1, 3)  # [B, S, H, hd]
+    return x + jnp.einsum("bshk,hkd->bsd", o.astype(x.dtype), p["wo"])
+
+
+def _mlp_block(cfg: LlamaConfig, p, x):
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,dm->bsm", h, p["w_gate"])
+    up = jnp.einsum("bsd,dm->bsm", h, p["w_up"])
+    return x + jnp.einsum("bsm,md->bsd", jax.nn.silu(gate) * up, p["w_down"])
+
+
+def forward(cfg: LlamaConfig, params, tokens, *, remat: bool = False):
+    """tokens [B, S] int32 → logits [B, S, vocab] (f32)."""
+    B, S = tokens.shape
+    x = params["embed"][tokens]
+    cos, sin = rope_tables(cfg, S)
+
+    def block(x, p):
+        x = _attention_block(cfg, p, x, cos, sin)
+        return _mlp_block(cfg, p, x)
+
+    if remat:
+        block = jax.checkpoint(block)
+    for p in params["layers"]:
+        x = block(x, p)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+
+
+def next_token_loss(cfg: LlamaConfig, params, tokens, targets, *, remat: bool = False):
+    logits = forward(cfg, params, tokens, remat=remat)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)
+    return nll.mean()
+
+
+# ---------------------------------------------------------------------------
+# sharded training step
+
+
+def param_shardings(cfg: LlamaConfig, mesh, rules):
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda axes: NamedSharding(mesh, rules.spec(axes)),
+        logical_axes(cfg),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def batch_sharding(mesh, rules):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, rules.spec(("batch", "seq")))
+
+
+def init_sharded(cfg: LlamaConfig, mesh, rules, rng, optimizer=None):
+    """Init params (and optimizer state) directly onto the mesh: the init
+    computation is jitted with explicit out_shardings so no host has to
+    hold a full replica (how 7B+ params fit a v4-32 host)."""
+    shardings = param_shardings(cfg, mesh, rules)
+    params = jax.jit(partial(init_params, cfg), out_shardings=shardings)(rng)
+    if optimizer is None:
+        return params
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state
+
+
+def make_train_step(cfg: LlamaConfig, optimizer, *, remat: bool = False, donate: bool = True):
+    """Returns jitted ``step((params, opt_state), batch) → (state, loss)``.
+
+    Gradient reduction over data/fsdp axes is inserted by GSPMD from the
+    input shardings — there is no hand-written psum (scaling-book recipe:
+    annotate, compile, let XLA place collectives on ICI).
+    """
+    import optax
+
+    def step(state, batch):
+        params, opt_state = state
+        loss, grads = jax.value_and_grad(
+            lambda p: next_token_loss(cfg, p, batch["tokens"], batch["targets"], remat=remat)
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,175 @@
+"""Task timeline: chrome://tracing dump of profile events.
+
+Reference: ``ray.timeline`` (``python/ray/_private/profiling.py:124``,
+``_private/state.py:948``) — emits chrome-tracing JSON of task lifecycle
+events. Redesigned single-file equivalent: every process records
+``ProfileEvent``s into a bounded in-memory ring buffer; the driver dumps
+its own buffer plus any events workers exported through the controller KV
+(``ray_tpu:events:<worker>`` keys) into one chrome-trace file loadable in
+chrome://tracing or Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_MAX_EVENTS = 100_000
+
+
+@dataclass
+class ProfileEvent:
+    name: str
+    category: str
+    start_us: float
+    end_us: float
+    pid: int = field(default_factory=os.getpid)
+    tid: int = 0
+    args: Optional[Dict[str, Any]] = None
+
+
+_events: "deque[ProfileEvent]" = deque(maxlen=_MAX_EVENTS)
+_lock = threading.Lock()
+_total_recorded = 0
+
+
+def _now_us() -> float:
+    # Wall clock, not perf_counter: events from many processes are merged
+    # into one trace, so timestamps need a shared epoch.
+    return time.time_ns() / 1e3
+
+
+def record_event(
+    name: str,
+    category: str,
+    start_us: float,
+    end_us: float,
+    args: Optional[Dict[str, Any]] = None,
+) -> None:
+    global _total_recorded
+    ev = ProfileEvent(
+        name=name,
+        category=category,
+        start_us=start_us,
+        end_us=end_us,
+        tid=threading.get_ident() % 1_000_000,
+        args=args,
+    )
+    with _lock:
+        _events.append(ev)
+        _total_recorded += 1
+
+
+@contextmanager
+def profile(name: str, category: str = "task", **args):
+    """Context manager recording one complete event (cf. ray.profiling)."""
+    start = _now_us()
+    try:
+        yield
+    finally:
+        record_event(name, category, start, _now_us(), args=args or None)
+
+
+def timeline_events() -> List[ProfileEvent]:
+    with _lock:
+        return list(_events)
+
+
+def clear_events() -> None:
+    with _lock:
+        _events.clear()
+
+
+_EVENTS_KV_PREFIX = b"ray_tpu:events:"
+_export_count = 0
+_export_chunk = 0
+
+
+def _collect_remote_events() -> List[ProfileEvent]:
+    """Pull worker-exported event chunks from the controller KV (prefix
+    scan — no shared index, so concurrent exporters can't race)."""
+    out: List[ProfileEvent] = []
+    try:
+        from ray_tpu.core import api
+
+        worker = api.get_global_worker_or_none()
+        if worker is None:
+            return out
+        backend = worker.backend
+        for key in backend.kv_keys(_EVENTS_KV_PREFIX):
+            blob = backend.kv_get(key)
+            if blob:
+                for d in json.loads(blob):
+                    out.append(ProfileEvent(**d))
+    except Exception:
+        pass
+    return out
+
+
+def export_events_to_kv() -> None:
+    """Worker-side: publish NEW events (since the last export) as one
+    immutable chunk under a per-process key — writes are O(delta), and no
+    cross-process read-modify-write exists anywhere."""
+    global _export_count, _export_chunk
+    from ray_tpu.core import api
+
+    worker = api.get_global_worker_or_none()
+    if worker is None:
+        return
+    with _lock:
+        fresh_n = min(_total_recorded - _export_count, len(_events))
+        fresh = list(_events)[-fresh_n:] if fresh_n > 0 else []
+        _export_count = _total_recorded
+    if not fresh:
+        return
+    key = f"ray_tpu:events:{os.getpid()}:{_export_chunk:06d}"
+    _export_chunk += 1
+    worker.backend.kv_put(key.encode(), json.dumps([ev.__dict__ for ev in fresh]).encode())
+
+
+def start_export_thread(period_s: float = 2.0) -> threading.Thread:
+    """Background exporter for worker processes: ships new events to the
+    controller KV so driver-side ``timeline()`` sees remote task spans
+    without a worker round-trip. Idle workers cost nothing (delta export)."""
+
+    def _loop():
+        while True:
+            time.sleep(period_s)
+            try:
+                export_events_to_kv()
+            except Exception:
+                pass
+
+    t = threading.Thread(target=_loop, daemon=True, name="timeline-export")
+    t.start()
+    return t
+
+
+def dump_timeline(filename: Optional[str] = None) -> Any:
+    """Dump chrome://tracing JSON. Returns the trace list (and writes
+    ``filename`` if given) — matches ``ray.timeline`` semantics."""
+    trace = []
+    for ev in timeline_events() + _collect_remote_events():
+        trace.append(
+            {
+                "name": ev.name,
+                "cat": ev.category,
+                "ph": "X",
+                "ts": ev.start_us,
+                "dur": max(0.0, ev.end_us - ev.start_us),
+                "pid": ev.pid,
+                "tid": ev.tid,
+                "args": ev.args or {},
+            }
+        )
+    trace.sort(key=lambda e: e["ts"])
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -91,8 +91,11 @@ class _Coordinator:
 
     async def post(self, key, value):
         import asyncio
+        from collections import deque
 
-        self._mailbox[key] = value
+        # Queue per (src, dst, tag) key: two sends before the receiver's
+        # take must both be delivered, in order — never overwritten.
+        self._mailbox.setdefault(key, deque()).append(value)
         ev = self._mailbox_events.get(key)
         if ev is None:
             ev = self._mailbox_events[key] = asyncio.Event()
@@ -104,10 +107,16 @@ class _Coordinator:
         ev = self._mailbox_events.get(key)
         if ev is None:
             ev = self._mailbox_events[key] = asyncio.Event()
-        await ev.wait()
-        value = self._mailbox.pop(key)
-        del self._mailbox_events[key]
-        return value
+        while True:
+            q = self._mailbox.get(key)
+            if q:
+                value = q.popleft()
+                if not q:
+                    del self._mailbox[key]
+                    ev.clear()
+                return value
+            ev.clear()
+            await ev.wait()
 
 
 class CollectiveGroup:

@@ -1,0 +1,47 @@
+"""ray_tpu.train — distributed training orchestration, TPU-first.
+
+Reference: ``python/ray/train`` (Trainer/BackendExecutor/WorkerGroup/
+session — see trainer.py docstring for the mapping). The flagship entry
+point is ``JaxTrainer``; sharding/parallelism *inside* the training step
+lives in ``ray_tpu.parallel`` (mesh/pjit/shard_map) and ``ray_tpu.ops``
+(pallas kernels) — the trainer orchestrates processes, XLA moves bytes.
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxBackendConfig
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
+from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "FailureConfig",
+    "JaxBackend",
+    "JaxBackendConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "TrainingFailedError",
+    "TrainWorker",
+    "WorkerGroup",
+    "get_checkpoint",
+    "get_context",
+    "report",
+]

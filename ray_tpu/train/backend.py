@@ -1,0 +1,99 @@
+"""Training backends: per-framework worker-group setup hooks.
+
+Reference: ``train/_internal/backend_executor.py`` Backend plugin protocol
++ ``train/torch/config.py:66-116`` (the NCCL/gloo rendezvous this replaces)
+and ``train/torch/xla/config.py`` (the XLA variant). TPU-native redesign:
+the backend's job is *jax.distributed* bootstrap — rank 0 publishes a
+coordinator address; every worker calls ``jax.distributed.initialize`` so
+one global device mesh spans all slice hosts and XLA collectives ride ICI.
+There is no per-op communicator plumbing to set up: collectives live
+inside the compiled program.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class BackendConfig:
+    """Base; subclasses pick the backend class."""
+
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Hooks around the worker group lifecycle."""
+
+    def on_start(self, worker_group: WorkerGroup, backend_config: BackendConfig) -> None:
+        pass
+
+    def on_training_start(self, worker_group: WorkerGroup, backend_config: BackendConfig) -> None:
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup, backend_config: BackendConfig) -> None:
+        pass
+
+
+@dataclass
+class JaxBackendConfig(BackendConfig):
+    """jax.distributed bootstrap config.
+
+    distributed=None → auto (initialize when num_workers > 1).
+    platform: force ``JAX_PLATFORMS`` in workers (tests: ``"cpu"``).
+    """
+
+    distributed: Optional[bool] = None
+    platform: Optional[str] = None
+    extra_env: Optional[Dict[str, str]] = None
+
+    def backend_cls(self):
+        return JaxBackend
+
+
+def _jax_distributed_init(context) -> None:
+    """Runs inside each worker, before the user loop (setup_fn)."""
+    import os
+
+    coordinator = os.environ.get("RAY_TPU_JAX_COORDINATOR")
+    if not coordinator:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=context.world_size,
+        process_id=context.world_rank,
+    )
+
+
+class JaxBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup, backend_config: JaxBackendConfig) -> None:
+        n = worker_group.num_workers
+        distributed = (
+            backend_config.distributed
+            if backend_config.distributed is not None
+            else n > 1
+        )
+        env: Dict[str, str] = dict(backend_config.extra_env or {})
+        if backend_config.platform:
+            env["JAX_PLATFORMS"] = backend_config.platform
+        if distributed:
+            # Rank 0's host + a free port = the jax.distributed coordinator
+            # (replaces the reference's torch worker-0 TCP rendezvous,
+            # train/torch/config.py:66-116).
+            addr = worker_group.execute_single(0, "get_address", timeout=30)
+            env["RAY_TPU_JAX_COORDINATOR"] = f"{addr['host']}:{addr['free_port']}"
+        if env:
+            worker_group.execute("set_env", env, timeout=30)
+
+    def setup_fn(self):
+        """Per-worker pre-loop hook handed to start_training."""
+        return _jax_distributed_init

@@ -1,0 +1,114 @@
+"""Worker-side training session: context + report channel.
+
+Reference: ``train/_internal/session.py:111,403,667`` — the per-worker
+session object behind ``train.report`` / ``train.get_context``. Redesign:
+the user loop runs on a plain thread inside the TrainWorker actor; each
+``report(metrics, checkpoint=...)`` enqueues onto a thread-safe queue the
+trainer drains via the ``poll_results`` actor method (pull, not push — the
+driver controls pacing, and a dead driver can't wedge a worker).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclass
+class TrainContext:
+    world_size: int = 1
+    world_rank: int = 0
+    local_rank: int = 0
+    node_rank: int = 0
+    experiment_name: str = ""
+    trial_dir: str = ""
+    #: checkpoint to resume from (set on group restart)
+    checkpoint: Optional[Checkpoint] = None
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+
+class _Session:
+    """One per worker process while a training run is active."""
+
+    def __init__(self, context: TrainContext):
+        self.context = context
+        self.results: "queue.Queue[Dict[str, Any]]" = queue.Queue()
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
+        self.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+    def drain(self, max_items: int = 64):
+        out = []
+        while len(out) < max_items:
+            try:
+                out.append(self.results.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+
+_session: Optional[_Session] = None
+_session_lock = threading.Lock()
+
+
+def _start_session(context: TrainContext) -> _Session:
+    global _session
+    with _session_lock:
+        _session = _Session(context)
+        return _session
+
+
+def _end_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def _get_session() -> _Session:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active — report()/get_context() must be "
+            "called inside a train_loop_per_worker"
+        )
+    return _session
+
+
+# --- public API (``ray_tpu.train.report`` etc.) --------------------------
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) -> None:
+    """Reference: ``train.report`` (``_internal/session.py:667``)."""
+    _get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    return _get_session().context
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """Checkpoint to resume from, if the group restarted after a failure."""
+    return _get_session().context.checkpoint

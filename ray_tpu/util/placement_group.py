@@ -101,3 +101,33 @@ def tpu_slice_bundles(num_hosts: int, chips_per_host: int = 4, topology: str = "
             b[tpu_slice_head_resource(topology)] = 1.0
         bundles.append(b)
     return bundles
+
+
+def tpu_slice_placement_group(
+    pod_type: str,
+    *,
+    strategy: str = "STRICT_SPREAD",
+    name: str = "",
+) -> PlacementGroup:
+    """Gang-reserve one whole pod slice from its type string (e.g.
+    ``tpu_slice_placement_group("v4-32")`` → 4 STRICT_SPREAD bundles of 4
+    chips, bundle 0 holding the ``TPU-v4-32-head`` marker). The canonical
+    way to place one trainer worker per slice host."""
+    from ray_tpu.accelerators import (
+        pod_type_chips_per_host,
+        pod_type_num_chips,
+        pod_type_num_hosts,
+        slice_head_resource_name,
+    )
+
+    hosts = pod_type_num_hosts(pod_type)
+    per_host = pod_type_chips_per_host(pod_type)
+    total = pod_type_num_chips(pod_type)
+    bundles: List[Dict[str, float]] = []
+    for i in range(hosts):
+        chips = per_host if i < hosts - 1 else total - per_host * (hosts - 1)
+        b: Dict[str, float] = {"TPU": float(min(chips, per_host))}
+        if i == 0:
+            b[slice_head_resource_name(pod_type)] = 1.0
+        bundles.append(b)
+    return placement_group(bundles, strategy=strategy, name=name)
