@@ -135,6 +135,7 @@ class NodeDaemon:
         self._view: List[_ViewNode] = []
         self._peer_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._tasks: List[asyncio.Task] = []
+        self._capacity_event = asyncio.Event()
         self._stopping = False
         for name in [m for m in dir(self) if m.startswith("d_")]:
             self.server.register(name[2:], getattr(self, name))
@@ -286,6 +287,7 @@ class NodeDaemon:
             # lease — adding them to the idle pool too would double-grant
             # one worker to two leases (deadlock on its execution lane).
             self.idle.append(w)
+            self._notify_capacity()
         return {"node_id": self.node_id.binary()}
 
     async def _run_actor_creation(self, w: WorkerProc, spec: TaskSpec) -> None:
@@ -331,22 +333,51 @@ class NodeDaemon:
 
     # ---- leases (task scheduling) -------------------------------------
     async def d_request_lease(self, payload, conn):
-        """The lease hot path (``HandleRequestWorkerLease``)."""
+        """The lease hot path (``HandleRequestWorkerLease``).
+
+        Requests that can't be served *right now* are queued daemon-side
+        (waiting on capacity/worker changes) rather than bounced back —
+        client retry-polling collapses throughput under backlog (reference:
+        raylet queues lease requests in the local task manager)."""
         request: Dict[str, float] = payload["resources"]
         strategy = payload.get("strategy")
+        deadline = time.monotonic() + 30.0
+        while True:
+            reply = await self._try_lease(request, strategy)
+            if reply is not None:
+                return reply
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"retry_after": 0.05}
+            try:
+                await asyncio.wait_for(
+                    self._capacity_event.wait(), timeout=min(0.5, remaining)
+                )
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
+
+    def _notify_capacity(self) -> None:
+        """Wake queued lease requests (set() resolves current waiters even
+        though we clear immediately — single-threaded loop)."""
+        self._capacity_event.set()
+        self._capacity_event.clear()
+
+    async def _try_lease(self, request: Dict[str, float], strategy):
+        """One grant attempt: dict reply, or None = queue and retry."""
         # Placement-group leases consume from the bundle pool.
         bundle_key = None
         if isinstance(strategy, PlacementGroupScheduling):
             bundle_key = self._find_bundle(strategy, request)
             if bundle_key is None:
-                return {"retry_after": 0.1}
+                return None
             pool = self._bundle_pools[bundle_key]
             req = ResourceSet(request)
             pool.allocate(req)
         else:
             req = ResourceSet(request)
             if not self.resources.can_fit(req):
-                return self._spillback_or_retry(request, strategy)
+                reply = self._spillback_or_retry(request, strategy)
+                return None if "retry_after" in reply else reply
             # hybrid: spill when local utilization is past the threshold
             if (
                 self.resources.utilization() >= GLOBAL_CONFIG.scheduler_spread_threshold
@@ -363,7 +394,7 @@ class NodeDaemon:
                 self._bundle_pools[bundle_key].release(ResourceSet(request))
             else:
                 self.resources.release(ResourceSet(request))
-            return {"retry_after": 0.05}
+            return None
         worker.leased = True
         self._lease_counter += 1
         lease = Lease(self._lease_counter, request, worker, bundle_key)
@@ -394,7 +425,7 @@ class NodeDaemon:
                     self._bundle_pools[bundle_key].release(ResourceSet(request))
                 else:
                     self.resources.release(ResourceSet(request))
-                return {"retry_after": 0.1}
+                return None
             worker.tpu_chips = chips
             lease.tpu_chips = chips
         self.leases[lease.lease_id] = lease
@@ -482,6 +513,7 @@ class NodeDaemon:
             self.resources.release(req)
         w = lease.worker
         w.leased = False
+        self._notify_capacity()
         if w.tpu_chips is not None and w.actor_id is None:
             # Chip-bound pooled worker: libtpu is (possibly) initialized on
             # these chips, so the process can never serve a different chip
@@ -538,6 +570,7 @@ class NodeDaemon:
                 pool.release(req)
         else:
             self.resources.release(req)
+        self._notify_capacity()
 
     async def d_kill_worker(self, payload, conn):
         actor_id = payload.get("actor_id")
@@ -571,6 +604,7 @@ class NodeDaemon:
                 return True
             raise RuntimeError("commit without prepare")
         self._bundle_pools[key] = NodeResources(ResourceSet(resources))
+        self._notify_capacity()
         return True
 
     async def d_release_bundle(self, payload, conn):

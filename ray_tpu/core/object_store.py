@@ -287,10 +287,11 @@ class StoreClient:
         self._lock = threading.Lock()
 
     def create_and_write(self, object_id: ObjectID, ser) -> int:
-        """Write a SerializedValue into a fresh segment; returns size."""
+        """Write a SerializedValue into a fresh segment; returns size.
+
+        Serialized bytes go straight into the mapped segment (one copy) —
+        the put-GB/s hot path."""
         size = ser.total_bytes
-        buf = bytearray()
-        ser.write_into(buf)
         try:
             seg = _create(segment_name(object_id), size)
         except FileExistsError:
@@ -299,13 +300,13 @@ class StoreClient:
             # if the new payload is larger than the old segment, unlink and
             # recreate — POSIX unlink keeps existing readers' mappings valid.
             seg = _attach(segment_name(object_id))
-            if len(seg.buf) < len(buf):
+            if len(seg.buf) < size:
                 try:
                     seg.unlink()
                 finally:
                     seg.close()
                 seg = _create(segment_name(object_id), size)
-        seg.buf[: len(buf)] = buf
+        ser.write_into_view(memoryview(seg.buf))
         with self._lock:
             # Drop stale cached mappings (both caches): after a re-produce
             # the old unlinked inode must not win future read()s.

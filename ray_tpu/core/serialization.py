@@ -84,6 +84,24 @@ class SerializedValue:
             buf += _LEN.pack(len(mv))
             buf += mv
 
+    def write_into_view(self, out: memoryview) -> int:
+        """Write directly into a writable buffer (the shm segment) —
+        single copy for large arrays instead of bytearray-then-shm."""
+        off = 0
+        header = _HEADER.pack(len(self.buffers), len(self.meta))
+        out[off : off + len(header)] = header
+        off += len(header)
+        out[off : off + len(self.meta)] = self.meta
+        off += len(self.meta)
+        for b in self.buffers:
+            mv = memoryview(b).cast("B")
+            ln = _LEN.pack(len(mv))
+            out[off : off + len(ln)] = ln
+            off += len(ln)
+            out[off : off + len(mv)] = mv
+            off += len(mv)
+        return off
+
 
 def _find_custom(obj: Any) -> Optional[Tuple[Type, Tuple[Callable, Callable]]]:
     for cls, pair in _custom_serializers.items():
