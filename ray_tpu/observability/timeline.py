@@ -15,6 +15,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -37,6 +38,7 @@ class ProfileEvent:
 _events: "deque[ProfileEvent]" = deque(maxlen=_MAX_EVENTS)
 _lock = threading.Lock()
 _total_recorded = 0
+_exporter_uid = uuid.uuid4().hex[:8]
 
 
 def _now_us() -> float:
@@ -128,7 +130,9 @@ def export_events_to_kv() -> None:
         _export_count = _total_recorded
     if not fresh:
         return
-    key = f"ray_tpu:events:{os.getpid()}:{_export_chunk:06d}"
+    # Key on (startup-unique uuid, pid): bare pids collide across nodes in
+    # a multi-node cluster and one worker's chunks would overwrite another's.
+    key = f"ray_tpu:events:{_exporter_uid}:{os.getpid()}:{_export_chunk:06d}"
     _export_chunk += 1
     worker.backend.kv_put(key.encode(), json.dumps([ev.__dict__ for ev in fresh]).encode())
 

@@ -80,8 +80,16 @@ class CheckpointManager:
         """Move a worker-produced checkpoint into the run dir."""
         dest = os.path.join(self.run_dir, f"checkpoint_{self._index:06d}")
         self._index += 1
-        if os.path.abspath(checkpoint.path) != dest:
-            shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+        src = os.path.abspath(checkpoint.path)
+        if src != dest:
+            staging_root = os.path.join(os.path.abspath(self.run_dir), "_staged")
+            if src.startswith(staging_root + os.sep) and not os.path.exists(dest):
+                # Session-staged copies are transport-only and already live
+                # on the run_dir filesystem — a rename beats a second full
+                # copy of a multi-GB checkpoint.
+                shutil.move(src, dest)
+            else:
+                shutil.copytree(src, dest, dirs_exist_ok=True)
         final = Checkpoint(dest)
         self.registered.append((dest, dict(metrics)))
         self._write_manifest()

@@ -32,11 +32,34 @@ class ScalingConfig:
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:
             res = dict(self.resources_per_worker)
-        elif self.use_tpu:
-            res = {"CPU": 1.0, "TPU": 4.0}
+            # An explicit TPU count wins; otherwise topology decides.
+            if self.topology and "TPU" not in res:
+                res["TPU"] = self._chips_per_host()
+        elif self.use_tpu or self.topology:
+            # Topology is authoritative: a v5e/v6e host has 8 chips, not
+            # the bare use_tpu default of 4.
+            res = {"CPU": 1.0, "TPU": self._chips_per_host()}
         else:
             res = {"CPU": 1.0}
         return res
+
+    def _chips_per_host(self) -> float:
+        if self.topology:
+            from ray_tpu.accelerators import (
+                pod_type_chips_per_host,
+                pod_type_num_chips,
+            )
+
+            # Sub-host slices (v5e-4 = 4 chips on an 8-chip host machine)
+            # expose only their own chips — never request more than the
+            # slice has in total.
+            return float(
+                min(
+                    pod_type_chips_per_host(self.topology),
+                    pod_type_num_chips(self.topology),
+                )
+            )
+        return 4.0
 
     def resolved_num_workers(self) -> int:
         if self.topology:
@@ -50,13 +73,8 @@ class ScalingConfig:
         n = self.resolved_num_workers()
         bundles = [dict(per_worker) for _ in range(n)]
         if self.topology:
-            from ray_tpu.accelerators import (
-                pod_type_chips_per_host,
-                slice_head_resource_name,
-            )
+            from ray_tpu.accelerators import slice_head_resource_name
 
-            for b in bundles:
-                b.setdefault("TPU", float(pod_type_chips_per_host(self.topology)))
             bundles[0][slice_head_resource_name(self.topology)] = 1.0
         return bundles
 

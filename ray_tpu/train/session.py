@@ -57,9 +57,37 @@ class _Session:
         self.results: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        self._report_seq = 0
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
+        checkpoint = self._stage_checkpoint(checkpoint)
         self.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+    def _stage_checkpoint(self, checkpoint: Optional[Checkpoint]) -> Optional[Checkpoint]:
+        """Re-home a node-local checkpoint under the shared trial dir.
+
+        A Checkpoint pickles as a bare path; one created in a worker's
+        /tmp is unreadable from the driver on a multi-host gang. The
+        trial_dir is on shared storage (the same assumption orbax makes),
+        so copying there at report time makes the path valid everywhere.
+        """
+        trial_dir = self.context.trial_dir
+        if checkpoint is None or not trial_dir:
+            return checkpoint
+        import os
+        import shutil
+
+        abs_path = os.path.abspath(checkpoint.path)
+        if abs_path.startswith(os.path.abspath(trial_dir) + os.sep):
+            return checkpoint
+        dest = os.path.join(
+            trial_dir,
+            "_staged",
+            f"rank_{self.context.world_rank:04d}_{self._report_seq:06d}",
+        )
+        self._report_seq += 1
+        shutil.copytree(abs_path, dest, dirs_exist_ok=True)
+        return Checkpoint(dest)
 
     def drain(self, max_items: int = 64):
         out = []

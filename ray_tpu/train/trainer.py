@@ -114,6 +114,14 @@ class JaxTrainer:
         run_dir: str,
         resume: Optional[Checkpoint],
     ) -> Result:
+        # Reap orphaned session-staged checkpoint copies from prior failed
+        # attempts — queued reports that never drained leave their _staged
+        # dirs behind, and nothing else ever deletes them.
+        staged_root = os.path.join(run_dir, "_staged")
+        if os.path.isdir(staged_root):
+            import shutil
+
+            shutil.rmtree(staged_root, ignore_errors=True)
         scaling = self.scaling_config
         n = scaling.resolved_num_workers()
         backend: Backend = self.backend_config.backend_cls()()
@@ -191,9 +199,11 @@ class JaxTrainer:
             # worker may have queued its final checkpoint, which the restart
             # needs.
             errors = []
+            drained_this_tick = 0
             for rank, poll in enumerate(polls):
                 if poll["error"] is not None:
                     errors.append(poll["error"])
+                drained_this_tick += len(poll["reports"])
                 for report in poll["reports"]:
                     ckpt = report.get("checkpoint")
                     if rank == 0:
@@ -205,8 +215,15 @@ class JaxTrainer:
                         )
                         if rank == 0:
                             last_metrics["_checkpoint_path"] = final.path
-                done[rank] = done[rank] or poll["done"]
-            if errors:
+                # A finished rank may still hold >drain-cap queued reports
+                # (poll drains at most 64 at a time) — only count it done
+                # once its queue comes back empty, so the final checkpoint
+                # is never dropped.
+                done[rank] = poll["done"] and not poll["reports"]
+            if errors and drained_this_tick == 0:
+                # Only raise once every queue came back empty: a crashing
+                # worker may have >drain-cap reports queued with its final
+                # checkpoint in the tail, which the restart needs.
                 raise TrainingFailedError(str(pickle.loads(errors[0])))
             if not all(done):
                 time.sleep(0.05)

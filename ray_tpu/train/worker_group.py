@@ -23,6 +23,28 @@ from ray_tpu.util.placement_group import PlacementGroup, placement_group, remove
 from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
 
 
+def _node_ip_address() -> str:
+    """Routed-address probe for this node's reachable IP.
+
+    ``gethostbyname(gethostname())`` commonly resolves to loopback
+    (127.0.1.1 in /etc/hosts on Debian images), which would publish an
+    unreachable jax.distributed coordinator address. Connecting a UDP
+    socket to a public address (no packets sent) asks the kernel which
+    interface would route there — mirrors the reference's
+    ``ray._private.services.get_node_ip_address``.
+    """
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            ip = s.getsockname()[0]
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    ip = socket.gethostbyname(socket.gethostname())
+    return ip
+
+
 class TrainWorker:
     """Actor hosting one training process (one slice host on TPU)."""
 
@@ -34,7 +56,7 @@ class TrainWorker:
 
     # -- host/topology info (backend rendezvous) ------------------------
     def get_address(self) -> Dict[str, Any]:
-        host = socket.gethostbyname(socket.gethostname())
+        host = _node_ip_address()
         with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
             s.bind(("", 0))
             free_port = s.getsockname()[1]
@@ -89,10 +111,14 @@ class TrainWorker:
     def poll_results(self) -> Dict[str, Any]:
         """Drain buffered ``report()`` calls; reference
         ``backend_executor.get_next_results``."""
+        # Snapshot done BEFORE draining: the train thread enqueues its last
+        # report and only then sets _done, so the reverse order could report
+        # done=True with that final report still queued.
+        done = self._done.is_set()
         reports = self._session.drain() if self._session else []
         return {
             "reports": reports,
-            "done": self._done.is_set(),
+            "done": done,
             "error": self._error,
         }
 
