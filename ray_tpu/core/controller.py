@@ -420,6 +420,8 @@ class Controller:
             return
         deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_s
         while time.monotonic() < deadline and not self._stopping:
+            if self.pgs.get(pg_id) is not info:
+                return  # removed while scheduling
             plan = place_bundles(self._alive_nodes(), info.bundles, info.strategy)
             if plan is not None:
                 # phase 1: prepare on every node
@@ -437,6 +439,8 @@ class Controller:
                         logger.warning("prepare_bundle failed: %r", e)
                         ok = False
                         break
+                if ok and self.pgs.get(pg_id) is not info:
+                    ok = False  # removed mid-2PC: roll back the prepares
                 if ok:
                     # phase 2: commit everywhere
                     for res in plan:
@@ -445,6 +449,19 @@ class Controller:
                             {"pg_id": pg_id, "bundle_index": res.bundle_index, "resources": res.resources},
                             timeout=10,
                         )
+                    if self.pgs.get(pg_id) is not info:
+                        # Removed between prepare and commit: release the
+                        # now-orphaned bundles instead of leaking them.
+                        for res in plan:
+                            try:
+                                await self.node_clients[res.node_id].call(
+                                    "release_bundle",
+                                    {"pg_id": pg_id, "bundle_index": res.bundle_index},
+                                    timeout=10,
+                                )
+                            except Exception:
+                                pass
+                        return
                     info.reservations = plan
                     info.state = "CREATED"
                     await self._publish(PG_PUSH_CHANNEL, {"pg_id": pg_id, "state": "CREATED"})
@@ -477,6 +494,11 @@ class Controller:
         info.state = "REMOVED"
         if info.name:
             self.named_pgs.pop(info.name, None)
+        # Drop the table entry: long-lived clusters cycle many PGs and the
+        # table would otherwise grow without bound. create_pg registers
+        # synchronously, so clients can infer unknown-id == removed.
+        self.pgs.pop(pg_id, None)
+        await self._publish(PG_PUSH_CHANNEL, {"pg_id": pg_id, "state": "REMOVED"})
         return {"ok": True}
 
     async def c_get_pg(self, payload, conn):

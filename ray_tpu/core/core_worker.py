@@ -515,10 +515,15 @@ class CoreWorker(RuntimeBackend):
             st.event.set()
 
     def _on_pg_push(self, msg: Dict[str, Any]) -> None:
-        self._pg_states[msg["pg_id"]] = msg["state"]
+        # Only track PGs this process has expressed interest in (created or
+        # waited on): pushes are cluster-wide, so caching every one would
+        # grow without bound in long-lived workers under PG churn. Waiters
+        # that miss a push recover via the poll fallback in wait_pg_ready.
         ev = self._pg_events.get(msg["pg_id"])
-        if ev is not None:
-            ev.set()
+        if ev is None:
+            return
+        self._pg_states[msg["pg_id"]] = msg["state"]
+        ev.set()
 
     async def _resolve_actor(self, actor_id: ActorID) -> _ActorState:
         with self._actors_lock:
@@ -651,25 +656,62 @@ class CoreWorker(RuntimeBackend):
             )
         )
 
+    _PG_TERMINAL = ("CREATED", "INFEASIBLE", "REMOVED")
+
+    _PG_POLL_INTERVAL_S = 2.0
+
     def wait_pg_ready(self, pg_id: bytes, timeout: Optional[float]) -> str:
+        """Block until the PG reaches a terminal state.
+
+        Push-driven with a polling fallback: interest (the event) is
+        registered before the first poll, so any transition after that poll
+        is pushed; slow re-polls only cover dropped pushes. The polled value
+        is never written to the push cache — a stale in-flight PENDING reply
+        must not clobber a concurrently-pushed terminal state.
+        """
         deadline = None if timeout is None else time.monotonic() + timeout
         ev = self._pg_events.setdefault(pg_id, threading.Event())
+        next_poll = 0.0
+        state: Optional[str] = None
         while True:
-            state = self._pg_states.get(pg_id)
-            if state is None:
+            pushed = self._pg_states.get(pg_id)
+            if pushed in self._PG_TERMINAL:
+                state = pushed
+            elif time.monotonic() >= next_poll:
                 info = self.io.run(self.controller.call("get_pg", {"pg_id": pg_id}))
-                state = info["state"] if info else None
-                if state:
-                    self._pg_states[pg_id] = state
-            if state in ("CREATED", "INFEASIBLE", "REMOVED"):
+                # create_pg registers synchronously, so an id the controller
+                # doesn't know was removed (the table drops entries on
+                # removal to bound memory).
+                state = info["state"] if info else "REMOVED"
+                next_poll = time.monotonic() + self._PG_POLL_INTERVAL_S
+            if state in self._PG_TERMINAL:
+                # Reclaim wait state here too: only a *local* remove_pg
+                # cleans up otherwise, and this process may not be the
+                # remover.
+                self._pg_states.pop(pg_id, None)
+                self._pg_events.pop(pg_id, None)
                 return state
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
                 return state or "PENDING"
+            # Clear → recheck → wait: clearing first avoids hot-spinning on
+            # an event set by an earlier push, and the recheck catches a
+            # push that landed before the clear (e.g. while the poll RPC
+            # above was in flight) so its wakeup is never lost.
+            ev.clear()
+            pushed = self._pg_states.get(pg_id)
+            if pushed in self._PG_TERMINAL:
+                self._pg_states.pop(pg_id, None)
+                self._pg_events.pop(pg_id, None)
+                return pushed
             ev.wait(min(0.2, remaining) if remaining is not None else 0.2)
 
     def remove_pg(self, pg_id: bytes) -> None:
         self.io.run(self.controller.call("remove_pg", {"pg_id": pg_id}))
+        # Drop per-pg wait state so long-lived drivers cycling many PGs
+        # (e.g. the microbenchmark) don't grow these maps without bound.
+        self._pg_states.pop(pg_id, None)
+        self._pg_events.pop(pg_id, None)
 
     def get_pg(self, pg_id: bytes):
         return self.io.run(self.controller.call("get_pg", {"pg_id": pg_id}))

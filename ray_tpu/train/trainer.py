@@ -199,11 +199,12 @@ class JaxTrainer:
             # worker may have queued its final checkpoint, which the restart
             # needs.
             errors = []
-            drained_this_tick = 0
+            errors_drained = True
             for rank, poll in enumerate(polls):
                 if poll["error"] is not None:
                     errors.append(poll["error"])
-                drained_this_tick += len(poll["reports"])
+                    if poll["reports"]:
+                        errors_drained = False
                 for report in poll["reports"]:
                     ckpt = report.get("checkpoint")
                     if rank == 0:
@@ -220,10 +221,12 @@ class JaxTrainer:
                 # once its queue comes back empty, so the final checkpoint
                 # is never dropped.
                 done[rank] = poll["done"] and not poll["reports"]
-            if errors and drained_this_tick == 0:
-                # Only raise once every queue came back empty: a crashing
-                # worker may have >drain-cap reports queued with its final
-                # checkpoint in the tail, which the restart needs.
+            if errors and errors_drained:
+                # Raise once every *erroring* rank's queue came back empty:
+                # a crashing worker may have >drain-cap reports queued with
+                # its final checkpoint in the tail, which the restart needs.
+                # Healthy ranks still streaming reports must not defer the
+                # gang restart indefinitely.
                 raise TrainingFailedError(str(pickle.loads(errors[0])))
             if not all(done):
                 time.sleep(0.05)

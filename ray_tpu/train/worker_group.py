@@ -111,15 +111,18 @@ class TrainWorker:
     def poll_results(self) -> Dict[str, Any]:
         """Drain buffered ``report()`` calls; reference
         ``backend_executor.get_next_results``."""
-        # Snapshot done BEFORE draining: the train thread enqueues its last
-        # report and only then sets _done, so the reverse order could report
-        # done=True with that final report still queued.
+        # Snapshot done and error BEFORE draining: the train thread enqueues
+        # its last report and only then sets _error/_done, so snapshotting
+        # first guarantees that when done/error shows up in a poll, every
+        # report enqueued before it is visible to this or a later drain —
+        # the trainer raises only once the erroring rank's queue is empty.
         done = self._done.is_set()
+        error = self._error
         reports = self._session.drain() if self._session else []
         return {
             "reports": reports,
             "done": done,
-            "error": self._error,
+            "error": error,
         }
 
     def finish(self) -> bool:

@@ -99,3 +99,24 @@ def test_pg_table(pg_cluster):
     assert pg.id.hex() in table
     assert table[pg.id.hex()]["name"] == "mypg"
     remove_placement_group(pg)
+
+
+def test_pg_churn_under_load(pg_cluster):
+    """Create/remove many PGs while long tasks hold leased workers.
+
+    Regression for the round-2 bench wedge: a get_pg poll reply carrying
+    PENDING could clobber a concurrently-pushed CREATED in the client's
+    state cache, after which wait_pg_ready never re-polled and hung until
+    timeout (reference churns PGs at 838/s, ``ray_perf.py``)."""
+
+    @ray_tpu.remote(num_cpus=0.5)
+    def slow():
+        time.sleep(8)
+        return 1
+
+    running = [slow.remote() for _ in range(4)]
+    for i in range(50):
+        pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+        pg.ready(timeout=30)
+        remove_placement_group(pg)
+    assert ray_tpu.get(running, timeout=120) == [1, 1, 1, 1]
