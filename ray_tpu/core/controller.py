@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -85,6 +86,10 @@ class Controller:
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
         self.pgs: Dict[bytes, PgInfo] = {}
         self.named_pgs: Dict[str, bytes] = {}
+        # Bounded tombstones for removed PGs: the table drops entries on
+        # removal (memory), but clients racing the removal need to tell
+        # "removed" apart from "never existed" to fail fast.
+        self.removed_pgs: "OrderedDict[bytes, None]" = OrderedDict()
         self.kv: Dict[bytes, bytes] = {}
         self.jobs: Dict[bytes, Dict[str, Any]] = {}
         self._subscribers: Set[ServerConnection] = set()
@@ -268,8 +273,20 @@ class Controller:
             return
         deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_s
         while time.monotonic() < deadline:
+            strategy = info.spec.scheduling_strategy
+            pg_id = getattr(strategy, "pg_id", None)
+            if pg_id is not None and pg_id not in self.pgs:
+                # PG removed (or never created) while the actor was being
+                # scheduled: fail fast instead of spinning to the lease
+                # deadline with a misleading resource error.
+                await self._finalize_actor_death(
+                    actor_id,
+                    f"placement group {pg_id.hex()[:12]} was removed before "
+                    "the actor could be scheduled",
+                )
+                return
             node = pick_node_hybrid(
-                self._alive_nodes(), info.spec.resources, info.spec.scheduling_strategy, self.pgs
+                self._alive_nodes(), info.spec.resources, strategy, self.pgs
             )
             if node is not None:
                 client = self.node_clients[node.node_id]
@@ -442,13 +459,32 @@ class Controller:
                 if ok and self.pgs.get(pg_id) is not info:
                     ok = False  # removed mid-2PC: roll back the prepares
                 if ok:
-                    # phase 2: commit everywhere
-                    for res in plan:
-                        await self.node_clients[res.node_id].call(
-                            "commit_bundle",
-                            {"pg_id": pg_id, "bundle_index": res.bundle_index, "resources": res.resources},
-                            timeout=10,
-                        )
+                    # phase 2: commit everywhere. A failed commit (node
+                    # died between prepare and commit) releases everything
+                    # and retries the whole placement — never wedge in
+                    # PENDING with bundles leaked on surviving nodes.
+                    committed: List[BundleReservation] = []
+                    try:
+                        for res in plan:
+                            await self.node_clients[res.node_id].call(
+                                "commit_bundle",
+                                {"pg_id": pg_id, "bundle_index": res.bundle_index, "resources": res.resources},
+                                timeout=10,
+                            )
+                            committed.append(res)
+                    except Exception as e:
+                        logger.warning("commit_bundle failed: %r", e)
+                        for res in plan:  # release both committed + prepared
+                            try:
+                                await self.node_clients[res.node_id].call(
+                                    "release_bundle",
+                                    {"pg_id": pg_id, "bundle_index": res.bundle_index},
+                                    timeout=10,
+                                )
+                            except Exception:
+                                pass
+                        await asyncio.sleep(0.2)
+                        continue
                     if self.pgs.get(pg_id) is not info:
                         # Removed between prepare and commit: release the
                         # now-orphaned bundles instead of leaking them.
@@ -495,15 +531,26 @@ class Controller:
         if info.name:
             self.named_pgs.pop(info.name, None)
         # Drop the table entry: long-lived clusters cycle many PGs and the
-        # table would otherwise grow without bound. create_pg registers
-        # synchronously, so clients can infer unknown-id == removed.
+        # table would otherwise grow without bound. A bounded tombstone
+        # lets racing clients tell "removed" apart from "never existed".
         self.pgs.pop(pg_id, None)
+        self.removed_pgs[pg_id] = None
+        while len(self.removed_pgs) > 4096:
+            self.removed_pgs.popitem(last=False)
         await self._publish(PG_PUSH_CHANNEL, {"pg_id": pg_id, "state": "REMOVED"})
         return {"ok": True}
 
     async def c_get_pg(self, payload, conn):
         info = self.pgs.get(payload["pg_id"])
         if info is None:
+            if payload["pg_id"] in self.removed_pgs:
+                return {
+                    "state": "REMOVED",
+                    "bundles": [],
+                    "strategy": "",
+                    "nodes": [],
+                    "bundle_indices": [],
+                }
             return None
         return {
             "state": info.state,

@@ -40,7 +40,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.float32
-    #: attention impl: "auto" | "pallas" | "xla" | "ring" (seq-parallel)
+    #: attention impl: "auto" | "pallas" | "xla" (dense local) or
+    #: "ring" | "ulysses" (sequence-parallel over the mesh's seq axis —
+    #: pass the mesh to ``forward``/``make_train_step``)
     attention_impl: str = "auto"
 
     @property
@@ -172,7 +174,7 @@ def apply_rope(x, cos, sin):
     return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
-def _attention_block(cfg: LlamaConfig, p, x, cos, sin):
+def _attention_block(cfg: LlamaConfig, p, x, cos, sin, mesh=None):
     B, S, _ = x.shape
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
@@ -181,16 +183,37 @@ def _attention_block(cfg: LlamaConfig, p, x, cos, sin):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     rep = cfg.n_heads // cfg.n_kv_heads
-    if rep > 1:
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    # [B, S, H, hd] → [B, H, S, hd]
-    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-    if cfg.attention_impl == "ring":
-        from ray_tpu.ops.ring_attention import ring_attention
+    if cfg.attention_impl in ("ring", "ulysses"):
+        if mesh is None:
+            raise ValueError(
+                f"attention_impl={cfg.attention_impl!r} is sequence-parallel: "
+                "pass the mesh to forward()/make_train_step()"
+            )
+        from ray_tpu.ops.ring_attention import (
+            ring_attention_sharded,
+            ulysses_attention_sharded,
+        )
+        from ray_tpu.parallel.mesh import TENSOR
 
-        o = ring_attention(qt, kt, vt, causal=True)
+        # [B, S, H, hd] → [B, H, S, hd]; K/V stay at n_kv_heads — the
+        # seq-parallel impls rotate/exchange the small GQA heads and
+        # repeat locally, keeping collective volume at 1/rep.
+        qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        tensor_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(TENSOR, 1)
+        if rep > 1 and cfg.n_kv_heads % tensor_size != 0:
+            # Too few KV heads for the tensor axis: pre-repeat (rare).
+            kt = jnp.repeat(kt, rep, axis=1)
+            vt = jnp.repeat(vt, rep, axis=1)
+            rep = 1
+        if cfg.attention_impl == "ring":
+            o = ring_attention_sharded(qt, kt, vt, mesh, causal=True, kv_repeat=rep)
+        else:
+            o = ulysses_attention_sharded(qt, kt, vt, mesh, causal=True)
     else:
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         o = flash_attention(qt, kt, vt, causal=True, impl=cfg.attention_impl)
     o = o.transpose(0, 2, 1, 3)  # [B, S, H, hd]
     return x + jnp.einsum("bshk,hkd->bsd", o.astype(x.dtype), p["wo"])
@@ -203,14 +226,17 @@ def _mlp_block(cfg: LlamaConfig, p, x):
     return x + jnp.einsum("bsm,md->bsd", jax.nn.silu(gate) * up, p["w_down"])
 
 
-def forward(cfg: LlamaConfig, params, tokens, *, remat: bool = False):
-    """tokens [B, S] int32 → logits [B, S, vocab] (f32)."""
+def forward(cfg: LlamaConfig, params, tokens, *, remat: bool = False, mesh=None):
+    """tokens [B, S] int32 → logits [B, S, vocab] (f32).
+
+    ``mesh`` is required for the sequence-parallel attention impls
+    ("ring"/"ulysses"), which shard_map over its ``seq`` axis."""
     B, S = tokens.shape
     x = params["embed"][tokens]
     cos, sin = rope_tables(cfg, S)
 
     def block(x, p):
-        x = _attention_block(cfg, p, x, cos, sin)
+        x = _attention_block(cfg, p, x, cos, sin, mesh=mesh)
         return _mlp_block(cfg, p, x)
 
     if remat:
@@ -221,8 +247,8 @@ def forward(cfg: LlamaConfig, params, tokens, *, remat: bool = False):
     return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
 
 
-def next_token_loss(cfg: LlamaConfig, params, tokens, targets, *, remat: bool = False):
-    logits = forward(cfg, params, tokens, remat=remat)
+def next_token_loss(cfg: LlamaConfig, params, tokens, targets, *, remat: bool = False, mesh=None):
+    logits = forward(cfg, params, tokens, remat=remat, mesh=mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)
     return nll.mean()
@@ -260,19 +286,22 @@ def init_sharded(cfg: LlamaConfig, mesh, rules, rng, optimizer=None):
     return params, opt_state
 
 
-def make_train_step(cfg: LlamaConfig, optimizer, *, remat: bool = False, donate: bool = True):
+def make_train_step(cfg: LlamaConfig, optimizer, *, remat: bool = False, donate: bool = True, mesh=None):
     """Returns jitted ``step((params, opt_state), batch) → (state, loss)``.
 
     Gradient reduction over data/fsdp axes is inserted by GSPMD from the
     input shardings — there is no hand-written psum (scaling-book recipe:
-    annotate, compile, let XLA place collectives on ICI).
+    annotate, compile, let XLA place collectives on ICI). ``mesh`` is
+    needed only for the sequence-parallel attention impls.
     """
     import optax
 
     def step(state, batch):
         params, opt_state = state
         loss, grads = jax.value_and_grad(
-            lambda p: next_token_loss(cfg, p, batch["tokens"], batch["targets"], remat=remat)
+            lambda p: next_token_loss(
+                cfg, p, batch["tokens"], batch["targets"], remat=remat, mesh=mesh
+            )
         )(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

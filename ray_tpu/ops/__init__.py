@@ -2,10 +2,20 @@
 
 from ray_tpu.ops.attention import flash_attention, reference_attention
 from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies
+from ray_tpu.ops.ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 
 __all__ = [
     "flash_attention",
     "reference_attention",
+    "ring_attention",
+    "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
     "rms_norm",
     "apply_rope",
     "rope_frequencies",
