@@ -463,7 +463,6 @@ class Controller:
                     # died between prepare and commit) releases everything
                     # and retries the whole placement — never wedge in
                     # PENDING with bundles leaked on surviving nodes.
-                    committed: List[BundleReservation] = []
                     try:
                         for res in plan:
                             await self.node_clients[res.node_id].call(
@@ -471,7 +470,6 @@ class Controller:
                                 {"pg_id": pg_id, "bundle_index": res.bundle_index, "resources": res.resources},
                                 timeout=10,
                             )
-                            committed.append(res)
                     except Exception as e:
                         logger.warning("commit_bundle failed: %r", e)
                         for res in plan:  # release both committed + prepared

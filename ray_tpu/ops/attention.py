@@ -4,10 +4,21 @@ The hot op of the model stack: blockwise attention with online softmax so
 the S×S score matrix never materializes in HBM — O(S) memory, MXU-friendly
 block matmuls, fp32 accumulators with bf16-friendly inputs.
 
-Forward and backward are both Pallas kernels wired through
-``jax.custom_vjp`` (FlashAttention-2 style backward: saved logsumexp,
-D = rowsum(dO·O), split dq and dk/dv passes). On non-TPU backends the
-kernels run in interpreter mode so CI exercises the same code path
+Pipelining design (the part that makes it beat plain XLA): the K/V stream
+is a *grid dimension*, not an in-kernel loop — each (1, block_k, d) K/V
+tile is its own BlockSpec block, so Pallas double-buffers the HBM→VMEM
+tile DMAs against the MXU work of the previous tile. The online-softmax
+state (m, l, acc) lives in VMEM scratch that persists across the K grid
+steps (grid dims are ("parallel", "parallel", "arbitrary")); the output
+tile is written once on the last K step. For causal masking the K tile
+index is *clamped* at the diagonal — Pallas skips the DMA when a block
+index repeats, so the masked-out upper-triangle tiles cost neither
+bandwidth nor (via ``pl.when``) compute.
+
+Forward and backward are Pallas kernels wired through ``jax.custom_vjp``
+(FlashAttention-2 backward: saved logsumexp, D = rowsum(dO·O), split dq
+and dk/dv passes, both K/Q-streamed the same way). On non-TPU backends
+the kernels run in interpreter mode so CI exercises the same code path
 (fake-ICI testing strategy, SURVEY §4.3).
 
 The reference stack has no equivalent op — attention lives inside torch
@@ -23,8 +34,25 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-DEFAULT_BLOCK = 128
+DEFAULT_BLOCK_Q = 512  # tuned on v5e: (512, 1024) wins at s=2048..8192
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
+
+
+def _pick_block(seq: int, want: int) -> Optional[int]:
+    """Largest block ≤ ``want`` that divides ``seq`` (scanning every
+    candidate ≥ 128, so e.g. seq=4160 picks 320). Sequences shorter than
+    128 become a single block; longer ones with no ≥128 divisor return
+    None — the caller raises rather than letting a seq-sized tile blow
+    VMEM."""
+    if seq < 128:
+        return seq
+    for b in range(min(want, seq), 127, -1):
+        if seq % b == 0:
+            return b
+    if seq <= 1024:
+        return seq  # single tile still fits VMEM comfortably
+    return None
 
 
 def _use_interpret() -> bool:
@@ -44,220 +72,299 @@ def reference_attention(q, k, v, *, causal: bool = True, sm_scale: Optional[floa
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
+def _last_kb(qb, block_q: int, block_k: int, num_kb: int):
+    """Last K tile index a causal Q tile attends to."""
+    return jnp.minimum(num_kb - 1, ((qb + 1) * block_q - 1) // block_k)
+
+
+def _causal_mask(s, qb, kb, block_q: int, block_k: int):
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
 # ---------------------------------------------------------------------------
-# forward kernel
+# forward kernel — grid (bh, num_q, num_k), K innermost ("arbitrary")
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, sm_scale: float):
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, block_q: int, block_k: int, num_kb: int, causal: bool, sm_scale: float,
+):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, d]
-    block_q, d = q.shape
-    seq_k = k_ref.shape[1]
     qb = pl.program_id(1)
+    kb = pl.program_id(2)
 
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    num_kb = seq_k // block_k
-    if causal:
-        # only blocks up to (and including) the diagonal contribute
-        upper = jax.lax.div((qb + 1) * block_q + block_k - 1, block_k)
-        upper = jnp.minimum(upper, num_kb)
-    else:
-        upper = num_kb
+    run = (kb <= _last_kb(qb, block_q, block_k, num_kb)) if causal else True
 
-    def body(kb, carry):
-        m, l, acc = carry
-        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            s = _causal_mask(s, qb, kb, block_q, block_k)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = alpha * acc + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
             p, vblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l = l_ref[...]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
-    grid = (bh, seq_q // block_q)
+    num_qb = seq_q // block_q
+    num_kb = seq_k // block_k
+    grid = (bh, num_qb, num_kb)
+
+    if causal:
+        # Clamp the K tile index at this Q tile's diagonal: repeated block
+        # indices skip the DMA, so masked-out tiles cost no bandwidth.
+        kv_idx = lambda b, i, j: (b, jnp.minimum(j, _last_kb(i, block_q, block_k, num_kb)), 0)
+    else:
+        kv_idx = lambda b, i, j: (b, j, 0)
+
     out_shape = [
         jax.ShapeDtypeStruct(q.shape, q.dtype),
         jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
     ]
     kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale
+        _fwd_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        num_kb=num_kb,
+        causal=causal,
+        sm_scale=sm_scale,
     )
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=_use_interpret(),
     )(q, k, v)
     return o, lse
 
 
 # ---------------------------------------------------------------------------
-# backward kernels (FlashAttention-2)
+# backward kernels (FlashAttention-2) — both streamed like the forward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, causal, sm_scale):
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
+    *, block_q: int, block_k: int, num_kb: int, causal: bool, sm_scale: float,
+):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]  # [block_q, 1]
-    delta = delta_ref[0]
-    block_q, d = q.shape
-    seq_k = k_ref.shape[1]
     qb = pl.program_id(1)
-    num_kb = seq_k // block_k
-    if causal:
-        upper = jnp.minimum(jax.lax.div((qb + 1) * block_q + block_k - 1, block_k), num_kb)
-    else:
-        upper = num_kb
+    kb = pl.program_id(2)
 
-    def body(kb, dq):
-        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    run = (kb <= _last_kb(qb, block_q, block_k, num_kb)) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q * sm_scale, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, qb, kb, block_q, block_k)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * sm_scale
-        return dq + jax.lax.dot_general(
+        dq_acc_ref[...] += jax.lax.dot_general(
             ds, kblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q, causal, sm_scale):
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, block_q: int, block_k: int, num_qb: int, causal: bool, sm_scale: float,
+):
     from jax.experimental import pallas as pl
 
-    kblk = k_ref[0].astype(jnp.float32)  # [bk, d]
-    vblk = v_ref[0].astype(jnp.float32)
-    block_k, d = kblk.shape
-    seq_q = q_ref.shape[1]
     kb = pl.program_id(1)
-    num_qb = seq_q // block_q
-    if causal:
-        lower = jax.lax.div(kb * block_k, block_q)
-    else:
-        lower = 0
+    qb = pl.program_id(2)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+    @pl.when(qb == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    run = (qb >= (kb * block_k) // block_q) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
         s = jax.lax.dot_general(
             q * sm_scale, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, qb, kb, block_q, block_k)
         p = jnp.exp(s - lse)  # [bq, bk]
-        dv_new = dv + jax.lax.dot_general(
+        dv_acc_ref[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * sm_scale
-        dk_new = dk + jax.lax.dot_general(
+        dk_acc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk_new, dv_new
 
-    dk, dv = jax.lax.fori_loop(
-        lower, num_qb, body, (jnp.zeros((block_k, d), jnp.float32), jnp.zeros((block_k, d), jnp.float32))
-    )
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qb == num_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     q, k, v, o, lse = res
     do = g
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # [bh, seq_q, 1]
+    num_qb = seq_q // block_q
+    num_kb = seq_k // block_k
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [bh, seq_q, 1]
+
+    if causal:
+        kv_idx = lambda b, i, j: (b, jnp.minimum(j, _last_kb(i, block_q, block_k, num_kb)), 0)
+    else:
+        kv_idx = lambda b, i, j: (b, j, 0)
+    q_idx = lambda b, i, j: (b, i, 0)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale),
-        grid=(bh, seq_q // block_q),
+        functools.partial(
+            _bwd_dq_kernel,
+            block_q=block_q, block_k=block_k, num_kb=num_kb,
+            causal=causal, sm_scale=sm_scale,
+        ),
+        grid=(bh, num_qb, num_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_q, d), q_idx),
+            pl.BlockSpec((1, block_q, 1), q_idx),
+            pl.BlockSpec((1, block_q, 1), q_idx),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), q_idx),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
 
+    if causal:
+        # Clamp the Q tile index from below at the diagonal: tiles above
+        # it contribute nothing to this K tile's dk/dv.
+        qd_idx = lambda b, j, i: (
+            b, jnp.maximum(i, (j * block_k) // block_q), 0
+        )
+    else:
+        qd_idx = lambda b, j, i: (b, i, 0)
+    kv2_idx = lambda b, j, i: (b, j, 0)
+
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal, sm_scale=sm_scale),
-        grid=(bh, seq_k // block_k),
+        functools.partial(
+            _bwd_dkv_kernel,
+            block_q=block_q, block_k=block_k, num_qb=num_qb,
+            causal=causal, sm_scale=sm_scale,
+        ),
+        grid=(bh, num_kb, num_qb),
         in_specs=[
-            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), qd_idx),
+            pl.BlockSpec((1, block_k, d), kv2_idx),
+            pl.BlockSpec((1, block_k, d), kv2_idx),
+            pl.BlockSpec((1, block_q, d), qd_idx),
+            pl.BlockSpec((1, block_q, 1), qd_idx),
+            pl.BlockSpec((1, block_q, 1), qd_idx),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv2_idx),
+            pl.BlockSpec((1, block_k, d), kv2_idx),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
@@ -292,8 +399,8 @@ def flash_attention(
     *,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK,
-    block_k: int = DEFAULT_BLOCK,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
     impl: str = "auto",
 ):
     """Multi-head attention. q/k/v: ``[batch, heads, seq, head_dim]``.
@@ -311,12 +418,12 @@ def flash_attention(
 
     b, h, seq_q, d = q.shape
     seq_k = k.shape[2]
-    block_q = min(block_q, seq_q)
-    block_k = min(block_k, seq_k)
-    if seq_q % block_q or seq_k % block_k:
+    block_q = _pick_block(seq_q, block_q)
+    block_k = _pick_block(seq_k, block_k)
+    if block_q is None or block_k is None:
         raise ValueError(
-            f"sequence lengths ({seq_q}, {seq_k}) must be divisible by "
-            f"block sizes ({block_q}, {block_k})"
+            f"sequence lengths ({seq_q}, {seq_k}) have no block divisor "
+            f"≥128 — pad the sequence to a multiple of 128"
         )
     qf = q.reshape(b * h, seq_q, d)
     kf = k.reshape(b * h, seq_k, d)
