@@ -51,6 +51,8 @@ class GlobalConfig:
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5
     lineage_pinning_enabled: bool = True
+    #: resubmission attempts per lost object (``task_manager.h:273``)
+    max_lineage_reconstructions: int = 3
 
     # --- RPC ---
     rpc_connect_timeout_s: float = 10.0

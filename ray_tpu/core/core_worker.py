@@ -166,15 +166,22 @@ class CoreWorker(RuntimeBackend):
     async def _get_owned(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
         oid = ref.id()
         loop = asyncio.get_event_loop()
-        timeout = None if deadline is None else max(0.0, deadline - time.monotonic())
-        obj = await loop.run_in_executor(None, self.refcounter.wait_ready, oid, timeout)
-        if obj is None or not obj.ready():
-            raise GetTimeoutError(f"get() timed out waiting for {oid.hex()[:12]}")
-        if obj.state == ObjState.FAILED:
-            return obj.error
-        if obj.inline is not None:
-            return serialization.deserialize_bytes(obj.inline)
-        return await self._fetch_from_locations(oid, list(obj.locations), deadline)
+        while True:
+            timeout = None if deadline is None else max(0.0, deadline - time.monotonic())
+            obj = await loop.run_in_executor(None, self.refcounter.wait_ready, oid, timeout)
+            if obj is None or not obj.ready():
+                raise GetTimeoutError(f"get() timed out waiting for {oid.hex()[:12]}")
+            if obj.state == ObjState.FAILED:
+                return obj.error
+            if obj.inline is not None:
+                return serialization.deserialize_bytes(obj.inline)
+            try:
+                return await self._fetch_from_locations(oid, list(obj.locations), deadline)
+            except ObjectLostError:
+                # Every copy is gone (node death): reconstruct from lineage
+                # by resubmitting the producing task, then wait again.
+                if not self._try_recover(oid):
+                    raise
 
     async def _get_borrowed(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
         oid = ref.id()
@@ -197,7 +204,19 @@ class CoreWorker(RuntimeBackend):
                 self.memory.put(oid, data)  # borrower-side cache
                 return serialization.deserialize_bytes(data)
             if kind == "locations":
-                return await self._fetch_from_locations(oid, status["locations"], deadline)
+                try:
+                    return await self._fetch_from_locations(oid, status["locations"], deadline)
+                except ObjectLostError:
+                    # Ask the owner to reconstruct, then re-poll status.
+                    try:
+                        recovered = await owner.call(
+                            "recover_object", {"object_id": oid.binary()}, timeout=30
+                        )
+                    except ConnectionLost:
+                        raise OwnerDiedError(oid, "owner died during recovery")
+                    if not recovered:
+                        raise
+                    continue
             if kind == "error":
                 return pickle.loads(status["error"])
             if kind == "unknown":
@@ -326,6 +345,48 @@ class CoreWorker(RuntimeBackend):
             self.refcounter.create_pending(oid, lineage=spec, hold=True)
         self._pin_deps(spec)
         self.io.post(self._submit_normal(spec))
+
+    def _try_recover(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction (``object_recovery_manager.h:90``): if
+        every copy of an owned object is lost, resubmit the producing
+        TaskSpec. Recursive losses recover naturally — the re-executed
+        task's workers fetch its args through the same get paths, which
+        recover *their* losses via this owner. Returns True if a
+        reconstruction is running (or already was); the caller re-waits."""
+        if not GLOBAL_CONFIG.lineage_pinning_enabled:
+            return False
+        state, spec, stale = self.refcounter.begin_reconstruction(
+            oid, GLOBAL_CONFIG.max_lineage_reconstructions
+        )
+        if state == "pending":
+            return True
+        if state != "started":
+            return False
+        logger.info(
+            "reconstructing lost object %s by resubmitting task %s",
+            oid.hex()[:12],
+            spec.name,
+        )
+        # Best-effort delete of previously-tracked copies: a transiently
+        # unreachable node may still hold one, which would otherwise leak
+        # (and, for a nondeterministic task, diverge from the new value).
+        for ret_id, locations in stale.items():
+            for loc in locations:
+                _nid, host, port = loc
+                self.io.post(
+                    self._delete_remote_copy(ret_id, host, port)
+                )
+        self._pin_deps(spec)
+        self.io.post(self._submit_normal(spec))
+        return True
+
+    async def _delete_remote_copy(self, oid: ObjectID, host: str, port: int) -> None:
+        try:
+            await self._client(host, port).call(
+                "delete_object", {"object_id": oid.binary()}, timeout=10
+            )
+        except Exception:
+            pass  # node is likely dead — that's why we're here
 
     def _pin_deps(self, spec: TaskSpec) -> None:
         for ref in spec.dependencies():
@@ -767,6 +828,11 @@ class CoreWorker(RuntimeBackend):
         if obj.inline is not None:
             return {"status": "inline", "data": obj.inline}
         return {"status": "locations", "locations": list(obj.locations)}
+
+    async def w_recover_object(self, payload, conn):
+        """Borrower-initiated lineage reconstruction: a borrower failed to
+        fetch any copy; the owner resubmits the producing task."""
+        return self._try_recover(ObjectID(payload["object_id"]))
 
     async def w_add_borrower(self, payload, conn):
         self.refcounter.add_borrower(ObjectID(payload["object_id"]))

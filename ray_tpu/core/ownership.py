@@ -24,7 +24,7 @@ import enum
 import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Set
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core.ids import ObjectID
 
@@ -51,6 +51,9 @@ class OwnedObject:
     contained: List[Any] = field(default_factory=list)
     lineage: Optional[Any] = None  # producing TaskSpec (reconstruction)
     waiters: List[threading.Event] = field(default_factory=list)
+    # lineage reconstruction bookkeeping (``object_recovery_manager.h:90``)
+    recovering: bool = False
+    reconstructions_left: int = -1  # -1 = not yet initialized from config
 
     def ready(self) -> bool:
         return self.state in (ObjState.AVAILABLE, ObjState.FAILED)
@@ -105,7 +108,14 @@ class ReferenceCounter:
             obj = self._objects.get(object_id)
             if obj is None:
                 obj = self._objects[object_id] = OwnedObject(local_refs=1 if hold else 0)
+            elif obj.state == ObjState.AVAILABLE and not obj.recovering:
+                # Objects are immutable: first completion wins. A late
+                # duplicate reply — or a recovery resubmission whose spec
+                # shares returns with a sibling that was never lost —
+                # must not overwrite (or fail) a healthy value.
+                return
             mutate(obj)
+            obj.recovering = False  # any completion ends a reconstruction
             self._wake(obj)
             if obj.refcount() == 0:
                 free_obj = self._objects.pop(object_id)
@@ -174,6 +184,55 @@ class ReferenceCounter:
                 return False
             obj.locations.discard(node_id)
             return obj.state == ObjState.AVAILABLE and not obj.locations and obj.inline is None
+
+    def begin_reconstruction(
+        self, object_id: ObjectID, max_attempts: int
+    ) -> Tuple[str, Optional[Any], Dict[ObjectID, List]]:
+        """Try to start lineage reconstruction of a lost object.
+
+        Returns ``(state, spec, stale_locations)``:
+        ``("started", spec, stale)`` — caller must resubmit ``spec``;
+        every *non-inline* return of the spec was reset to PENDING and
+        its previously-tracked locations are in ``stale`` (caller should
+        best-effort delete those copies: a transiently-unreachable node
+        may still hold one, which would otherwise leak — and diverge if
+        the task is nondeterministic).
+        ``("pending", None, {})`` — a reconstruction is already in
+        flight, just wait. ``("no", None, {})`` — can't recover (no
+        lineage, attempts exhausted, or object gone/failed).
+        """
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None or obj.lineage is None:
+                return ("no", None, {})
+            if obj.recovering or obj.state == ObjState.PENDING:
+                return ("pending", None, {})
+            if obj.state != ObjState.AVAILABLE:
+                return ("no", None, {})
+            if obj.reconstructions_left < 0:
+                obj.reconstructions_left = max_attempts
+            if obj.reconstructions_left == 0:
+                return ("no", None, {})
+            spec = obj.lineage
+            stale: Dict[ObjectID, List] = {}
+            # Reset the shm-resident returns of the producing task (the
+            # resubmission regenerates them). Inline returns live in the
+            # owner's memory and cannot be lost — leave them untouched.
+            for ret in getattr(spec, "return_ids", [object_id]):
+                ret_obj = self._objects.get(ret)
+                if ret_obj is None or ret_obj.inline is not None:
+                    continue
+                stale[ret] = list(ret_obj.locations)
+                ret_obj.state = ObjState.PENDING
+                ret_obj.locations.clear()
+                ret_obj.error = None
+                ret_obj.recovering = True
+                if ret_obj.reconstructions_left < 0:
+                    ret_obj.reconstructions_left = max_attempts
+                ret_obj.reconstructions_left = max(
+                    0, ret_obj.reconstructions_left - 1
+                )
+            return ("started", spec, stale)
 
     # -- refcounting -----------------------------------------------------
     def add_local(self, object_id: ObjectID) -> None:

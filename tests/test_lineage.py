@@ -1,0 +1,121 @@
+"""Lineage reconstruction: lost objects are rebuilt by resubmitting the
+producing task (reference ``object_recovery_manager.h:90``,
+``task_manager.h:273`` ResubmitTask)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def _make_cluster():
+    cluster = Cluster(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2, resources={"pin": 2})
+    time.sleep(1.0)
+    ray_tpu.init(address=cluster.address)
+    return cluster, n2
+
+
+def test_get_recovers_lost_object():
+    """Produce a big (shm) object on node B, kill B, get() — the owner
+    resubmits the producing task on a replacement node."""
+    cluster, n2 = _make_cluster()
+    try:
+
+        @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
+        def produce():
+            return np.ones(1 << 20, dtype=np.uint8)  # 1 MiB -> shm path
+
+        ref = produce.remote()
+        # wait WITHOUT fetching: the only shm copy must stay on node B
+        ready, _ = ray_tpu.wait([ref], timeout=120, fetch_local=False)
+        assert ready
+        cluster.remove_node(n2)
+        cluster.add_node(num_cpus=2, resources={"pin": 2})
+        time.sleep(1.0)
+        out = ray_tpu.get(ref, timeout=120)  # triggers reconstruction
+        assert out.sum() == 1 << 20
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_borrower_task_recovers_lost_dependency():
+    """A task consuming a lost ref triggers owner-side reconstruction
+    through the borrower fetch path (w_recover_object)."""
+    cluster, n2 = _make_cluster()
+    try:
+
+        @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
+        def produce():
+            return np.full(1 << 20, 7, dtype=np.uint8)
+
+        @ray_tpu.remote(num_cpus=1)
+        def consume(arr):
+            return int(arr[0]) + int(arr[-1])
+
+        ref = produce.remote()
+        ready, _ = ray_tpu.wait([ref], timeout=120, fetch_local=False)
+        assert ready
+        cluster.remove_node(n2)
+        cluster.add_node(num_cpus=2, resources={"pin": 2})
+        time.sleep(1.0)
+        assert ray_tpu.get(consume.remote(ref), timeout=120) == 14
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_put_object_loss_raises_object_lost():
+    """put() objects have no lineage: losing every copy surfaces
+    ObjectLostError instead of hanging in a recovery loop."""
+    cluster, _n2 = _make_cluster()
+    try:
+        import numpy as np
+
+        from ray_tpu.core.api import _global_worker
+
+        ref = ray_tpu.put(np.ones(1 << 20, dtype=np.uint8))
+        # Simulate losing the only shm copy: delete it from the head
+        # daemon's store behind the owner's back (the reference does the
+        # same with internal test hooks, ``_private/test_utils.py``).
+        core = _global_worker().backend
+        core.io.run(
+            core.daemon.call("delete_object", {"object_id": ref.id().binary()})
+        )
+        with pytest.raises(ray_tpu.ObjectLostError):
+            ray_tpu.get(ref, timeout=60)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_exhausted_reconstruction_attempts_raise():
+    """A ref whose producing task is out of reconstruction attempts
+    surfaces ObjectLostError."""
+    cluster, n2 = _make_cluster()
+    try:
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        old = GLOBAL_CONFIG.max_lineage_reconstructions
+        GLOBAL_CONFIG.max_lineage_reconstructions = 0
+        try:
+
+            @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
+            def produce():
+                return np.ones(1 << 20, dtype=np.uint8)
+
+            ref = produce.remote()
+            ready, _ = ray_tpu.wait([ref], timeout=120, fetch_local=False)
+            assert ready
+            cluster.remove_node(n2)
+            with pytest.raises(ray_tpu.ObjectLostError):
+                ray_tpu.get(ref, timeout=60)
+        finally:
+            GLOBAL_CONFIG.max_lineage_reconstructions = old
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
