@@ -54,6 +54,7 @@ class _ActorState:
         self.reason: str = ""
         self.max_task_retries: int = 0
         self.max_concurrency: int = 1
+        self.creation_spec = None  # pins implicit-put creation args
         self.event = threading.Event()  # set whenever state changes
 
 
@@ -175,12 +176,15 @@ class CoreWorker(RuntimeBackend):
                 return obj.error
             if obj.inline is not None:
                 return serialization.deserialize_bytes(obj.inline)
+            locations = list(obj.locations)
             try:
-                return await self._fetch_from_locations(oid, list(obj.locations), deadline)
+                return await self._fetch_from_locations(oid, locations, deadline)
             except ObjectLostError:
                 # Every copy is gone (node death): reconstruct from lineage
-                # by resubmitting the producing task, then wait again.
-                if not self._try_recover(oid):
+                # by resubmitting the producing task, then wait again. The
+                # observed set guards against destroying a copy created by
+                # a recovery that completed while we were fetching.
+                if not self._try_recover(oid, observed_locations=locations):
                     raise
 
     async def _get_borrowed(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
@@ -210,7 +214,9 @@ class CoreWorker(RuntimeBackend):
                     # Ask the owner to reconstruct, then re-poll status.
                     try:
                         recovered = await owner.call(
-                            "recover_object", {"object_id": oid.binary()}, timeout=30
+                            "recover_object",
+                            {"object_id": oid.binary(), "observed": status["locations"]},
+                            timeout=30,
                         )
                     except ConnectionLost:
                         raise OwnerDiedError(oid, "owner died during recovery")
@@ -346,7 +352,7 @@ class CoreWorker(RuntimeBackend):
         self._pin_deps(spec)
         self.io.post(self._submit_normal(spec))
 
-    def _try_recover(self, oid: ObjectID) -> bool:
+    def _try_recover(self, oid: ObjectID, observed_locations=None) -> bool:
         """Lineage reconstruction (``object_recovery_manager.h:90``): if
         every copy of an owned object is lost, resubmit the producing
         TaskSpec. Recursive losses recover naturally — the re-executed
@@ -356,7 +362,9 @@ class CoreWorker(RuntimeBackend):
         if not GLOBAL_CONFIG.lineage_pinning_enabled:
             return False
         state, spec, stale = self.refcounter.begin_reconstruction(
-            oid, GLOBAL_CONFIG.max_lineage_reconstructions
+            oid,
+            GLOBAL_CONFIG.max_lineage_reconstructions,
+            observed_locations=observed_locations,
         )
         if state == "pending":
             return True
@@ -373,20 +381,10 @@ class CoreWorker(RuntimeBackend):
         for ret_id, locations in stale.items():
             for loc in locations:
                 _nid, host, port = loc
-                self.io.post(
-                    self._delete_remote_copy(ret_id, host, port)
-                )
+                self.io.post(self._delete_remote(host, port, ret_id))
         self._pin_deps(spec)
         self.io.post(self._submit_normal(spec))
         return True
-
-    async def _delete_remote_copy(self, oid: ObjectID, host: str, port: int) -> None:
-        try:
-            await self._client(host, port).call(
-                "delete_object", {"object_id": oid.binary()}, timeout=10
-            )
-        except Exception:
-            pass  # node is likely dead — that's why we're here
 
     def _pin_deps(self, spec: TaskSpec) -> None:
         for ref in spec.dependencies():
@@ -562,6 +560,13 @@ class CoreWorker(RuntimeBackend):
             st = self._actors.setdefault(spec.actor_id, _ActorState())
             st.max_task_retries = spec.max_task_retries
             st.max_concurrency = max(1, spec.max_concurrency)
+            # Pin the creation spec for the actor's (restartable)
+            # lifetime: its args may be implicit-put objects (e.g. a list
+            # containing ObjectRefs) whose ONLY owner-side reference is
+            # the ObjectRef held by this spec — dropping it before the
+            # (possibly restarted) creation task fetches args would free
+            # them under the actor.
+            st.creation_spec = spec
         self.io.run(self.controller.call("register_actor", {"spec": spec}))
 
     def _on_actor_push(self, msg: Dict[str, Any]) -> None:
@@ -573,6 +578,8 @@ class CoreWorker(RuntimeBackend):
                 st.address = msg["address"]
             if msg.get("reason"):
                 st.reason = msg["reason"]
+            if msg["state"] == "DEAD":
+                st.creation_spec = None  # release pinned creation args
             st.event.set()
 
     def _on_pg_push(self, msg: Dict[str, Any]) -> None:
@@ -832,7 +839,10 @@ class CoreWorker(RuntimeBackend):
     async def w_recover_object(self, payload, conn):
         """Borrower-initiated lineage reconstruction: a borrower failed to
         fetch any copy; the owner resubmits the producing task."""
-        return self._try_recover(ObjectID(payload["object_id"]))
+        return self._try_recover(
+            ObjectID(payload["object_id"]),
+            observed_locations=payload.get("observed"),
+        )
 
     async def w_add_borrower(self, payload, conn):
         self.refcounter.add_borrower(ObjectID(payload["object_id"]))

@@ -186,7 +186,10 @@ class ReferenceCounter:
             return obj.state == ObjState.AVAILABLE and not obj.locations and obj.inline is None
 
     def begin_reconstruction(
-        self, object_id: ObjectID, max_attempts: int
+        self,
+        object_id: ObjectID,
+        max_attempts: int,
+        observed_locations: Optional[List] = None,
     ) -> Tuple[str, Optional[Any], Dict[ObjectID, List]]:
         """Try to start lineage reconstruction of a lost object.
 
@@ -209,6 +212,13 @@ class ReferenceCounter:
                 return ("pending", None, {})
             if obj.state != ObjState.AVAILABLE:
                 return ("no", None, {})
+            if observed_locations is not None and (
+                obj.locations - {tuple(l) for l in observed_locations}
+            ):
+                # A location the failed fetch never tried exists (e.g. a
+                # recovery completed in between): don't destroy it — the
+                # caller should simply re-fetch.
+                return ("pending", None, {})
             if obj.reconstructions_left < 0:
                 obj.reconstructions_left = max_attempts
             if obj.reconstructions_left == 0:

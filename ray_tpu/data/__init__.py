@@ -1,0 +1,36 @@
+"""ray_tpu.data — streaming, block-structured datasets over the runtime.
+
+Reference: ``python/ray/data/`` (Dataset / read_api / streaming executor
+/ block batching). See ``dataset.py`` for the TPU-first design notes."""
+
+from ray_tpu.data.block import Block, VALUE_COL
+from ray_tpu.data.dataset import Dataset, DataShard
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range_,
+    read_csv,
+    read_numpy,
+    read_parquet,
+)
+
+#: reference-parity alias (``ray.data.range``)
+range = range_  # noqa: A001
+
+__all__ = [
+    "Block",
+    "VALUE_COL",
+    "Dataset",
+    "DataShard",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_",
+    "read_csv",
+    "read_numpy",
+    "read_parquet",
+]

@@ -1,0 +1,280 @@
+"""Dataset: lazy, streaming, block-structured data over the object store.
+
+Reference surface: ``python/ray/data/dataset.py`` (map_batches / filter /
+flat_map / random_shuffle / limit / iter_batches / streaming_split /
+count / take / materialize) + ``read_api.py`` (from_items / range /
+read_parquet / read_csv / from_numpy / from_pandas).
+
+Design (idiomatic, not a port): a Dataset is (sources, fused transform
+chain), where a source is a read callable OR an ObjectRef to an already
+materialized block. Transforms append to the chain; execution fuses the
+whole chain into ONE remote task per block (reference MapFusion), blocks
+stream with bounded in-flight tasks, and consumers pull block refs as
+they complete.
+
+``streaming_split(n)`` partitions the *sources* deterministically
+(shard i takes sources i, i+n, ...): each shard is an independent
+Dataset the consuming worker executes itself. That makes shards
+re-iterable (epoch 2 re-executes the plan — reference semantics),
+keeps memory bounded by each consumer's in-flight window, and needs no
+coordinator. The trade-off vs the reference's splitter actor is static
+assignment instead of dynamic balancing of slow consumers."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    VALUE_COL,
+    block_concat,
+    block_num_rows,
+    block_slice,
+    block_take,
+    blocks_from_rows,
+    normalize_block,
+    rows_of,
+)
+from ray_tpu.data.executor import Source, execute_all, execute_streaming
+from ray_tpu.data.iterator import iter_batches_from_refs, iter_device_batches
+
+DEFAULT_BLOCK_SIZE = 1024  # rows per block for in-memory sources
+
+
+class Dataset:
+    """Lazy dataset: construct via ``ray_tpu.data.from_items/range/read_*``."""
+
+    def __init__(self, sources: Sequence[Source], transforms=None):
+        self._sources: List[Source] = list(sources)
+        self._transforms: List[Callable[[Block], Block]] = list(transforms or [])
+        self._materialized: Optional[List[Any]] = None  # block refs cache
+
+    # -- transforms (lazy, fused) ---------------------------------------
+    def _chain(self, t: Callable[[Block], Block]) -> "Dataset":
+        # A materialized dataset's refs become the new plan's sources, so
+        # transforms chained after shuffle/limit/etc. see the data.
+        sources = self._materialized if self._materialized is not None else self._sources
+        return Dataset(sources, self._transforms + [t] if self._materialized is None else [t])
+
+    def map_batches(
+        self,
+        fn: Callable[[Block], Any],
+        *,
+        batch_size: Optional[int] = None,
+    ) -> "Dataset":
+        """Apply ``fn`` to whole blocks (optionally re-chunked to
+        ``batch_size`` rows inside the task)."""
+        if batch_size is None:
+            return self._chain(lambda b: normalize_block(fn(b)))
+
+        def rechunked(block: Block) -> Block:
+            outs = []
+            n = block_num_rows(block)
+            for s in range(0, n, batch_size):
+                outs.append(normalize_block(fn(block_slice(block, s, min(n, s + batch_size)))))
+            return block_concat(outs) if outs else block
+        return self._chain(rechunked)
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        def per_row(block: Block) -> Block:
+            rows = [fn(r) for r in rows_of(block)]
+            return blocks_from_rows(rows, len(rows) or 1)[0] if rows else block
+        return self._chain(per_row)
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        def filt(block: Block) -> Block:
+            mask = np.asarray([bool(fn(r)) for r in rows_of(block)], bool)
+            return block_take(block, np.nonzero(mask)[0])
+        return self._chain(filt)
+
+    def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
+        def fm(block: Block) -> Block:
+            rows: List[Any] = []
+            for r in rows_of(block):
+                rows.extend(fn(r))
+            blocks = blocks_from_rows(rows, max(1, len(rows)))
+            return blocks[0] if blocks else {VALUE_COL: np.asarray([])}
+        return self._chain(fm)
+
+    # -- execution -------------------------------------------------------
+    def _block_refs(self) -> List[Any]:
+        if self._materialized is None:
+            self._materialized = execute_all(self._sources, self._transforms)
+        return self._materialized
+
+    def _stream_refs(self) -> Iterator[Any]:
+        if self._materialized is not None:
+            return iter(self._materialized)
+        return execute_streaming(self._sources, self._transforms)
+
+    def materialize(self) -> "Dataset":
+        self._block_refs()
+        return self
+
+    # -- global ops (require materialization) ----------------------------
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Global shuffle: materialize, concat, permute, re-block.
+        Reference: all-to-all exchange (``planner/exchange``); single-pass
+        materialized shuffle is the honest small-scale equivalent."""
+        refs = self._block_refs()
+        blocks = [ray_tpu.get(r, timeout=600) for r in refs]
+        if not blocks:
+            return self
+        merged = block_concat(blocks)
+        n = block_num_rows(merged)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(n)
+        shuffled = block_take(merged, perm)
+        per = max(1, n // max(1, len(blocks)))
+        out_blocks = [block_slice(shuffled, s, min(n, s + per)) for s in range(0, n, per)]
+        return _from_blocks(out_blocks)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        refs = self._block_refs()
+        blocks = [ray_tpu.get(r, timeout=600) for r in refs]
+        if not blocks:
+            return self
+        merged = block_concat(blocks)
+        n = block_num_rows(merged)
+        per = max(1, -(-n // num_blocks))
+        return _from_blocks(
+            [block_slice(merged, s, min(n, s + per)) for s in range(0, n, per)]
+        )
+
+    def limit(self, n: int) -> "Dataset":
+        taken: List[Block] = []
+        have = 0
+        for ref in self._stream_refs():
+            b = ray_tpu.get(ref, timeout=600)
+            rows = block_num_rows(b)
+            if have + rows >= n:
+                taken.append(block_slice(b, 0, n - have))
+                have = n
+                break
+            taken.append(b)
+            have += rows
+        return _from_blocks(taken)
+
+    # -- consumption -----------------------------------------------------
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        drop_last: bool = False,
+        prefetch_blocks: int = 2,
+    ) -> Iterator[Block]:
+        return iter_batches_from_refs(
+            self._stream_refs(),
+            batch_size=batch_size,
+            drop_last=drop_last,
+            prefetch_blocks=prefetch_blocks,
+        )
+
+    def iter_device_batches(self, *, batch_size=256, sharding=None, transform=None,
+                            drop_last: bool = False):
+        """Batches double-buffered onto the accelerator (host→device
+        overlap) — the TPU ingest path for JaxTrainer."""
+        return iter_device_batches(
+            self.iter_batches(batch_size=batch_size, drop_last=drop_last),
+            sharding=sharding,
+            transform=transform,
+        )
+
+    def iter_rows(self) -> Iterator[Any]:
+        for batch in self.iter_batches(batch_size=None):
+            yield from rows_of(batch)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for r in self.iter_rows():
+            out.append(r)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(
+            block_num_rows(ray_tpu.get(r, timeout=600)) for r in self._stream_refs()
+        )
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for ref in self._stream_refs():
+            b = ray_tpu.get(ref, timeout=600)
+            return {k: str(v.dtype) for k, v in b.items()}
+        return None
+
+    def num_blocks(self) -> int:
+        if self._materialized is not None:
+            return len(self._materialized)
+        return len(self._sources)
+
+    # -- splitting -------------------------------------------------------
+    def split(self, n: int) -> List["Dataset"]:
+        """Materializing equal (by rows) split (reference ``Dataset.split``)."""
+        refs = self._block_refs()
+        blocks = [ray_tpu.get(r, timeout=600) for r in refs]
+        merged = block_concat(blocks) if blocks else {VALUE_COL: np.asarray([])}
+        total = block_num_rows(merged)
+        per = total // n
+        out = []
+        for i in range(n):
+            end = (i + 1) * per if i < n - 1 else total
+            out.append(_from_blocks([block_slice(merged, i * per, end)]))
+        return out
+
+    def streaming_split(self, n: int, *, equal: bool = False) -> List["DataShard"]:
+        """N disjoint, independently-executing, re-iterable shards — one
+        per Train worker (reference ``Dataset.streaming_split``).
+
+        ``equal=True`` materializes and splits by rows exactly;
+        ``equal=False`` (default) partitions sources round-robin with no
+        materialization (block-granular, so row counts may differ by up
+        to one block)."""
+        if equal:
+            parts = self.split(n)
+            return [
+                DataShard(p._materialized or p._sources, [], i, n)
+                for i, p in enumerate(parts)
+            ]
+        sources = self._materialized if self._materialized is not None else self._sources
+        transforms = [] if self._materialized is not None else self._transforms
+        return [DataShard(sources[i::n], transforms, i, n) for i in range(n)]
+
+    def __repr__(self) -> str:
+        return (
+            f"Dataset(blocks={self.num_blocks()}, "
+            f"transforms={len(self._transforms)})"
+        )
+
+
+def _from_blocks(blocks: List[Block]) -> Dataset:
+    refs = [ray_tpu.put(b) for b in blocks]
+    ds = Dataset(refs)
+    ds._materialized = list(refs)
+    return ds
+
+
+class DataShard(Dataset):
+    """One consumer's shard of a streaming_split — picklable (sources are
+    read callables or ObjectRefs), re-iterable every epoch, executed by
+    whichever worker consumes it."""
+
+    def __init__(self, sources, transforms, split_idx: int, num_splits: int):
+        super().__init__(sources, transforms)
+        self._idx = split_idx
+        self._n = num_splits
+
+    def __reduce__(self):
+        return (
+            DataShard,
+            (self._sources, self._transforms, self._idx, self._n),
+        )
+
+    def __repr__(self) -> str:
+        return f"DataShard({self._idx}/{self._n}, blocks={self.num_blocks()})"

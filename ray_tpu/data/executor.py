@@ -1,0 +1,87 @@
+"""Streaming execution over the task pool.
+
+Reference: ``data/_internal/execution/streaming_executor.py:48,89`` +
+``operators/task_pool_map_operator.py`` — blocks stream through remote
+tasks with bounded in-flight work (backpressure against the object
+store), and consecutive map stages are FUSED into one task per block
+(the reference's MapFusion rewrite) so intermediate blocks never exist.
+
+A *source* is either a no-arg read callable (fresh execution) or an
+ObjectRef to an existing block (re-transforming materialized data): ref
+sources are passed as task *arguments* so the dependency protocol
+fetches them on the executing worker.
+
+The executor yields block ObjectRefs as they become ready — consumption
+(iter_batches / streaming_split) overlaps with production."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, Sequence, Union
+
+import ray_tpu
+from ray_tpu.data.block import Block, normalize_block
+
+#: a transform maps one block to one block (fused chains compose)
+Transform = Callable[[Block], Block]
+#: read callable or a block ref
+Source = Union[Callable[[], Any], "ray_tpu.ObjectRef"]
+
+
+def _fused_task(read_fn, block, transforms: Sequence[Transform]) -> Block:
+    out = normalize_block(block if read_fn is None else read_fn())
+    for t in transforms:
+        out = normalize_block(t(out))
+    return out
+
+
+_fused_remote = None
+
+
+def _get_remote():
+    global _fused_remote
+    if _fused_remote is None:
+        _fused_remote = ray_tpu.remote(num_cpus=1)(_fused_task)
+    return _fused_remote
+
+
+def _submit(source: Source, transforms: Sequence[Transform]):
+    remote_fn = _get_remote()
+    if isinstance(source, ray_tpu.ObjectRef):
+        # ref source: ship as an arg so the dep protocol fetches the block
+        return remote_fn.remote(None, source, list(transforms))
+    return remote_fn.remote(source, None, list(transforms))
+
+
+def execute_streaming(
+    sources: Sequence[Source],
+    transforms: Sequence[Transform],
+    *,
+    max_inflight: int = 8,
+) -> Iterator["ray_tpu.ObjectRef"]:
+    """Run ``transforms`` fused over every source; yield block refs in
+    completion order with at most ``max_inflight`` tasks outstanding."""
+    if not transforms and sources and all(
+        isinstance(s, ray_tpu.ObjectRef) for s in sources
+    ):
+        # materialized + no work: the blocks ARE the result
+        yield from sources
+        return
+    pending: List[Any] = []
+    idx = 0
+    n = len(sources)
+    while idx < n or pending:
+        while idx < n and len(pending) < max_inflight:
+            pending.append(_submit(sources[idx], transforms))
+            idx += 1
+        ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=None, fetch_local=False)
+        for ref in ready:
+            yield ref
+
+
+def execute_all(
+    sources: Sequence[Source],
+    transforms: Sequence[Transform],
+    *,
+    max_inflight: int = 8,
+) -> List["ray_tpu.ObjectRef"]:
+    return list(execute_streaming(sources, transforms, max_inflight=max_inflight))

@@ -1,0 +1,121 @@
+"""Batch iteration with prefetch + host→device double-buffering.
+
+Reference: ``data/_internal/block_batching/`` (prefetching batchers) and
+``data/iterator.py`` — the piece Train actually needs on TPU: while step
+N computes on device, batch N+1 is already being sliced on host and
+transferred, so input never serializes behind compute."""
+
+from __future__ import annotations
+
+import threading
+from queue import Queue
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+import ray_tpu
+from ray_tpu.data.block import Block, block_concat, block_num_rows, block_slice
+
+_SENTINEL = object()
+
+
+def iter_batches_from_refs(
+    ref_iter: Iterable,
+    *,
+    batch_size: Optional[int],
+    drop_last: bool = False,
+    prefetch_blocks: int = 2,
+) -> Iterator[Block]:
+    """Slice/merge a stream of block refs into batches of ``batch_size``
+    rows, fetching up to ``prefetch_blocks`` blocks ahead in a background
+    thread (pipeline fill while the consumer computes).
+
+    Abandoning the generator early (take(), a training loop that breaks)
+    stops the producer thread: it checks a stop flag around the bounded
+    queue put, so it never blocks forever holding blocks alive."""
+    q: Queue = Queue(maxsize=max(1, prefetch_blocks))
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except Exception:  # queue.Full
+                continue
+        return False
+
+    def _producer():
+        try:
+            for ref in ref_iter:
+                if not _put(ray_tpu.get(ref, timeout=600)):
+                    return
+                if stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — surface in consumer
+            _put(e)
+            return
+        _put(_SENTINEL)
+
+    t = threading.Thread(target=_producer, daemon=True, name="batch-prefetch")
+    t.start()
+
+    try:
+        leftover: Optional[Block] = None
+        while True:
+            item = q.get()
+            if isinstance(item, BaseException):
+                raise item
+            if item is _SENTINEL:
+                break
+            block: Block = item
+            if batch_size is None:
+                yield block
+                continue
+            if leftover is not None:
+                block = block_concat([leftover, block])
+                leftover = None
+            n = block_num_rows(block)
+            start = 0
+            while n - start >= batch_size:
+                yield block_slice(block, start, start + batch_size)
+                start += batch_size
+            if start < n:
+                leftover = block_slice(block, start, n)
+        if leftover is not None and not drop_last:
+            yield leftover
+    finally:
+        stop.set()
+        # drain so a producer blocked mid-put can observe the flag
+        try:
+            while True:
+                q.get_nowait()
+        except Exception:
+            pass
+
+
+def iter_device_batches(
+    batch_iter: Iterable[Block],
+    *,
+    sharding=None,
+    transform: Optional[Callable[[Block], Dict[str, Any]]] = None,
+) -> Iterator[Dict[str, Any]]:
+    """Double-buffer host batches onto device: batch N+1's device_put is
+    issued (async) while the caller computes on batch N."""
+    import jax
+
+    def put(b: Block):
+        if transform is not None:
+            b = transform(b)
+        if sharding is not None:
+            return jax.device_put(b, sharding)
+        return jax.device_put(b)
+
+    it = iter(batch_iter)
+    try:
+        current = put(next(it))
+    except StopIteration:
+        return
+    for nxt in it:
+        staged = put(nxt)  # async dispatch: overlaps consumer compute
+        yield current
+        current = staged
+    yield current

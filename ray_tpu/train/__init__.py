@@ -19,6 +19,7 @@ from ray_tpu.train.session import (
     TrainContext,
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     report,
 )
 from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
@@ -43,5 +44,6 @@ __all__ = [
     "WorkerGroup",
     "get_checkpoint",
     "get_context",
+    "get_dataset_shard",
     "report",
 ]

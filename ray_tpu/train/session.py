@@ -137,6 +137,16 @@ def get_context() -> TrainContext:
     return _get_session().context
 
 
+def get_dataset_shard(name: str = "train"):
+    """This rank's split of a Dataset passed to ``JaxTrainer(datasets=)``
+    (reference ``ray.train.get_dataset_shard``). Returns a
+    ``ray_tpu.data.DataShard`` with ``iter_batches`` /
+    ``iter_device_batches``, or None if no such dataset was configured."""
+    ctx = get_context()
+    shards = ctx.metadata.get("dataset_shards", {})
+    return shards.get(name)
+
+
 def get_checkpoint() -> Optional[Checkpoint]:
     """Checkpoint to resume from, if the group restarted after a failure."""
     return _get_session().context.checkpoint
