@@ -303,9 +303,14 @@ class CoreWorker(RuntimeBackend):
             _nid, host, port = loc
             self.io.post(self._delete_remote(host, port, oid))
 
-    async def _delete_remote(self, host, port, oid):
+    async def _delete_remote(self, host, port, oid, timeout: float = 10.0):
+        # Bounded: the target node may be dead or partitioned (that's often
+        # exactly why a delete is being sent) — never leave the coroutine
+        # awaiting a reply forever.
         try:
-            await self._client(host, port).call("delete_object", {"object_id": oid.binary()})
+            await self._client(host, port).call(
+                "delete_object", {"object_id": oid.binary()}, timeout=timeout
+            )
         except Exception:
             pass
 

@@ -59,7 +59,10 @@ def execute_streaming(
     max_inflight: int = 8,
 ) -> Iterator["ray_tpu.ObjectRef"]:
     """Run ``transforms`` fused over every source; yield block refs in
-    completion order with at most ``max_inflight`` tasks outstanding."""
+    SOURCE order (reference ray.data preserves block order, so take()/
+    limit() are deterministic) with at most ``max_inflight`` tasks
+    outstanding. Later tasks keep running while the head block is
+    awaited — order costs no pipeline parallelism, only yield order."""
     if not transforms and sources and all(
         isinstance(s, ray_tpu.ObjectRef) for s in sources
     ):
@@ -73,9 +76,9 @@ def execute_streaming(
         while idx < n and len(pending) < max_inflight:
             pending.append(_submit(sources[idx], transforms))
             idx += 1
-        ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=None, fetch_local=False)
-        for ref in ready:
-            yield ref
+        head = pending.pop(0)
+        ray_tpu.wait([head], num_returns=1, timeout=None, fetch_local=False)
+        yield head
 
 
 def execute_all(

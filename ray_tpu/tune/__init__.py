@@ -1,0 +1,34 @@
+"""ray_tpu.tune — experiment execution: trials, search, schedulers.
+
+Reference: ``python/ray/tune/`` (Tuner/TuneController, basic-variant
+search, ASHA). See ``tuner.py`` for the controller design."""
+
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.trial import Trial, get_config, report
+from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "ResultGrid",
+    "Trial",
+    "TrialResult",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_config",
+    "grid_search",
+    "loguniform",
+    "qrandint",
+    "randint",
+    "report",
+    "uniform",
+]

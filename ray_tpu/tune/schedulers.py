@@ -1,0 +1,75 @@
+"""Trial schedulers: FIFO + ASHA early stopping.
+
+Reference: ``tune/schedulers/async_hyperband.py:19`` — Async Successive
+Halving (ASHA): rungs at ``grace_period * reduction_factor^k``; when a
+trial reports at a rung milestone it continues only if its metric is in
+the top ``1/reduction_factor`` quantile of everything that has reached
+that rung; everyone else stops. Asynchronous: no waiting for a full
+bracket — decisions use whatever peers have arrived."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """No early stopping: every trial runs to completion."""
+
+    def on_result(self, trial_id: str, iteration: int, metric_value: float) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        pass
+
+
+class ASHAScheduler:
+    def __init__(
+        self,
+        *,
+        metric: str | None = None,
+        mode: str | None = None,
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+    ):
+        if mode not in (None, "min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        #: metric/mode may be left None and inherited from TuneConfig —
+        #: the Tuner resolves them before the first on_result call.
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestone -> {trial_id: best metric recorded at this rung}
+        self._rungs: Dict[int, Dict[str, float]] = {}
+        milestone = grace_period
+        while milestone < max_t:
+            self._rungs[milestone] = {}
+            milestone *= reduction_factor
+
+    def _milestones(self) -> List[int]:
+        return sorted(self._rungs)
+
+    def on_result(self, trial_id: str, iteration: int, metric_value: float) -> str:
+        v = -metric_value if self.mode == "min" else metric_value
+        for milestone in self._milestones():
+            if iteration < milestone:
+                break
+            rung = self._rungs[milestone]
+            if trial_id in rung:
+                continue  # already judged at this rung
+            rung[trial_id] = v
+            # top-1/rf cutoff among peers that reached the rung
+            values = sorted(rung.values(), reverse=True)
+            k = max(1, len(values) // self.rf)
+            cutoff = values[k - 1]
+            if v < cutoff:
+                return STOP
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        pass

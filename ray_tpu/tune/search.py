@@ -1,0 +1,110 @@
+"""Search: param-space resolution + trial variant generation.
+
+Reference: ``python/ray/tune/search/basic_variant.py`` (grid + random
+sampling) and ``tune/search/variant_generator.py``. Search spaces are
+plain dicts whose leaves may be ``grid_search([...])``, ``choice``,
+``uniform``, ``loguniform``, ``randint`` or callables; grids expand to
+the cross product, sampled leaves draw ``num_samples`` times."""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, Iterator, List, Tuple
+
+
+class _Grid:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+class _Sampler:
+    def __init__(self, fn: Callable[[random.Random], Any]):
+        self.fn = fn
+
+    def sample(self, rng: random.Random) -> Any:
+        return self.fn(rng)
+
+
+def grid_search(values) -> _Grid:
+    return _Grid(values)
+
+
+def choice(options) -> _Sampler:
+    opts = list(options)
+    return _Sampler(lambda rng: rng.choice(opts))
+
+
+def uniform(low: float, high: float) -> _Sampler:
+    return _Sampler(lambda rng: rng.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> _Sampler:
+    lo, hi = math.log(low), math.log(high)
+    return _Sampler(lambda rng: math.exp(rng.uniform(lo, hi)))
+
+
+def randint(low: int, high: int) -> _Sampler:
+    return _Sampler(lambda rng: rng.randrange(low, high))
+
+
+def qrandint(low: int, high: int, q: int = 1) -> _Sampler:
+    # clamp after quantizing — floor division can otherwise dip below low
+    return _Sampler(lambda rng: max(low, (rng.randrange(low, high) // q) * q))
+
+
+def _walk(space: Dict[str, Any], path=()) -> Iterator[Tuple[Tuple[str, ...], Any]]:
+    for k, v in space.items():
+        p = path + (k,)
+        if isinstance(v, dict):
+            yield from _walk(v, p)
+        else:
+            yield p, v
+
+
+def _set_path(d: Dict[str, Any], path: Tuple[str, ...], value: Any) -> None:
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def generate_variants(
+    param_space: Dict[str, Any],
+    num_samples: int = 1,
+    seed: int | None = None,
+) -> List[Dict[str, Any]]:
+    """Expand grids (cross product) × draw samples ``num_samples`` times."""
+    import copy
+
+    rng = random.Random(seed)
+    grid_paths: List[Tuple[Tuple[str, ...], _Grid]] = []
+    sample_paths: List[Tuple[Tuple[str, ...], _Sampler]] = []
+    const_paths: List[Tuple[Tuple[str, ...], Any]] = []
+    for path, leaf in _walk(param_space):
+        if isinstance(leaf, _Grid):
+            grid_paths.append((path, leaf))
+        elif isinstance(leaf, _Sampler):
+            sample_paths.append((path, leaf))
+        elif callable(leaf):
+            sample_paths.append((path, _Sampler(lambda rng, f=leaf: f())))
+        else:
+            const_paths.append((path, leaf))
+
+    grid_combos = (
+        list(itertools.product(*[g.values for _, g in grid_paths]))
+        if grid_paths
+        else [()]
+    )
+    variants: List[Dict[str, Any]] = []
+    for _ in range(max(1, num_samples)):
+        for combo in grid_combos:
+            cfg: Dict[str, Any] = {}
+            for path, value in const_paths:
+                _set_path(cfg, path, copy.deepcopy(value))
+            for (path, _g), value in zip(grid_paths, combo):
+                _set_path(cfg, path, value)
+            for path, sampler in sample_paths:
+                _set_path(cfg, path, sampler.sample(rng))
+            variants.append(cfg)
+    return variants

@@ -1,0 +1,243 @@
+"""Tuner: the experiment controller.
+
+Reference: ``tune/tuner.py:344`` (Tuner.fit) driving
+``tune/execution/tune_controller.py:68,666`` — an event loop that
+launches trial actors up to the concurrency limit, polls their result
+queues, feeds each report to the scheduler (ASHA may STOP a trial), and
+collects everything into a ResultGrid.
+
+TPU-first notes: trials reserve resources through the normal scheduling
+path (``resources_per_trial`` may include TPU or a placement-group
+strategy), and a JaxTrainer ``fit()`` can be the trainable — the trial
+actor is control-plane only, the gang runs under it."""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import generate_variants
+from ray_tpu.tune.trial import (
+    ERRORED,
+    PENDING,
+    RUNNING,
+    STOPPED,
+    TERMINATED,
+    Trial,
+    TrialRunner,
+)
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None  # FIFOScheduler | ASHAScheduler
+    seed: Optional[int] = None
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    metrics_history: List[Dict[str, Any]]
+    status: str
+    error: Optional[str] = None
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: Optional[str], mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i: int) -> TrialResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[TrialResult]:
+        return [r for r in self._results if r.status == ERRORED]
+
+    def get_best_result(
+        self, metric: Optional[str] = None, mode: Optional[str] = None
+    ) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("no metric given (set TuneConfig.metric)")
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]
+        return max(scored, key=key) if mode == "max" else min(scored, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = {"trial_id": r.trial_id, "status": r.status}
+            row.update({f"config/{k}": v for k, v in r.config.items() if not isinstance(v, dict)})
+            row.update(r.metrics)
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    """``Tuner(trainable, param_space=..., tune_config=...).fit()``.
+
+    ``trainable`` is a function ``fn(config) -> None|dict`` reporting via
+    ``ray_tpu.tune.report`` — or an object with ``.fit()`` and a
+    ``train_loop_config`` attribute (e.g. JaxTrainer), run per-trial with
+    the variant config merged into its loop config."""
+
+    def __init__(
+        self,
+        trainable: Any,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+    ):
+        self._trainable = self._as_function(trainable)
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.resources_per_trial = resources_per_trial or {"CPU": 1.0}
+
+    @staticmethod
+    def _as_function(trainable: Any) -> Callable[[Dict[str, Any]], Any]:
+        if callable(trainable):
+            return trainable
+        if hasattr(trainable, "fit"):
+            # Trainer-as-trainable (reference train/base_trainer.py:608):
+            # merge the variant into train_loop_config, run the gang, and
+            # report the final metrics.
+            import copy
+            import dataclasses
+
+            def run_trainer(config: Dict[str, Any]):
+                from ray_tpu.tune.trial import get_trial_id
+
+                trainer = copy.copy(trainable)
+                merged = dict(getattr(trainer, "_train_config", None) or {})
+                merged.update(config)
+                trainer._train_config = merged
+                # Per-trial run dir: trials must not share checkpoint
+                # state (a shared dir would make trial 2 silently resume
+                # trial 1's checkpoint with different hyperparameters).
+                rc = getattr(trainer, "run_config", None)
+                if rc is not None:
+                    trainer.run_config = dataclasses.replace(
+                        rc, name=f"{rc.name or 'tune'}-{get_trial_id()}"
+                    )
+                result = trainer.fit()
+                return dict(result.metrics)
+
+            return run_trainer
+        raise TypeError(f"trainable must be callable or have .fit(): {trainable!r}")
+
+    def fit(self) -> ResultGrid:
+        cfg = self.tune_config
+        scheduler = cfg.scheduler or FIFOScheduler()
+        # Resolve scheduler metric/mode from TuneConfig (reference: Tuner
+        # owns them unless the scheduler explicitly overrides) — a default
+        # ASHAScheduler() in a min-mode experiment must rank by min.
+        if getattr(scheduler, "mode", "x") is None:
+            scheduler.mode = cfg.mode
+        metric = getattr(scheduler, "metric", None) or cfg.metric
+        variants = generate_variants(
+            self.param_space, num_samples=cfg.num_samples, seed=cfg.seed
+        )
+        trials = [
+            Trial(trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:6]}", config=v)
+            for i, v in enumerate(variants)
+        ]
+        pending = list(trials)
+        running: List[Trial] = []
+        opts = dict(self.resources_per_trial)
+        num_cpus = opts.pop("CPU", 1.0)
+
+        while pending or running:
+            while pending and len(running) < cfg.max_concurrent_trials:
+                t = pending.pop(0)
+                t.actor = TrialRunner.options(
+                    num_cpus=num_cpus, resources=opts or None
+                ).remote()
+                ray_tpu.get(
+                    t.actor.run.remote(self._trainable, t.config, t.trial_id),
+                    timeout=120,
+                )
+                t.status = RUNNING
+                running.append(t)
+
+            still_running: List[Trial] = []
+            for t in running:
+                # Per-trial poll: one actor dying (worker OOM/crash) must
+                # mark THAT trial errored, not blow up the whole sweep.
+                try:
+                    poll = ray_tpu.get(t.actor.poll.remote(), timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    t.status = ERRORED
+                    t.error = f"trial actor died: {e!r}"
+                    scheduler.on_trial_complete(t.trial_id)
+                    continue
+                stop = False
+                for report in poll["reports"]:
+                    t.iterations += 1
+                    t.last_metrics = report
+                    t.metrics_history.append(report)
+                    value = report.get(metric) if metric else None
+                    if value is not None:
+                        decision = scheduler.on_result(
+                            t.trial_id, t.iterations, float(value)
+                        )
+                        if decision == STOP:
+                            stop = True
+                            break
+                if stop:
+                    t.status = STOPPED
+                    scheduler.on_trial_complete(t.trial_id)
+                    ray_tpu.kill(t.actor)
+                elif poll["error"] is not None and not poll["reports"]:
+                    t.status = ERRORED
+                    t.error = poll["error"]
+                    scheduler.on_trial_complete(t.trial_id)
+                    ray_tpu.kill(t.actor)
+                elif poll["done"] and not poll["reports"]:
+                    t.status = TERMINATED
+                    scheduler.on_trial_complete(t.trial_id)
+                    ray_tpu.kill(t.actor)
+                else:
+                    still_running.append(t)
+            running = still_running
+            if pending or running:
+                time.sleep(0.02)
+
+        return ResultGrid(
+            [
+                TrialResult(
+                    trial_id=t.trial_id,
+                    config=t.config,
+                    metrics=t.last_metrics,
+                    metrics_history=t.metrics_history,
+                    status=t.status,
+                    error=t.error,
+                )
+                for t in trials
+            ],
+            cfg.metric,
+            cfg.mode,
+        )

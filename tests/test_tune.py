@@ -1,0 +1,144 @@
+"""ray_tpu.tune tests: search spaces, ASHA, the controller e2e, and
+trainer-as-trainable (reference test model: ``tune/tests/``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_generate_variants_grid_product():
+    from ray_tpu.tune.search import generate_variants
+
+    vs = generate_variants(
+        {"a": tune.grid_search([1, 2, 3]), "b": tune.grid_search(["x", "y"]), "c": 7}
+    )
+    assert len(vs) == 6
+    assert all(v["c"] == 7 for v in vs)
+    assert {(v["a"], v["b"]) for v in vs} == {(a, b) for a in (1, 2, 3) for b in ("x", "y")}
+
+
+def test_generate_variants_samplers_and_num_samples():
+    from ray_tpu.tune.search import generate_variants
+
+    vs = generate_variants(
+        {"lr": tune.loguniform(1e-4, 1e-1), "bs": tune.choice([16, 32])},
+        num_samples=8,
+        seed=0,
+    )
+    assert len(vs) == 8
+    assert all(1e-4 <= v["lr"] <= 1e-1 for v in vs)
+    assert all(v["bs"] in (16, 32) for v in vs)
+    # nested spaces resolve too
+    vs2 = generate_variants({"opt": {"lr": tune.uniform(0, 1)}, "k": 3}, seed=1)
+    assert 0 <= vs2[0]["opt"]["lr"] <= 1
+
+
+def test_asha_scheduler_unit():
+    """Deterministic ASHA behavior: at a rung, values below the top-1/rf
+    cutoff stop."""
+    asha = tune.ASHAScheduler(mode="max", max_t=64, grace_period=4, reduction_factor=2)
+    assert asha.on_result("a", 4, 100.0) == CONTINUE  # first at rung: no peers
+    assert asha.on_result("b", 4, 50.0) == STOP  # below cutoff (100)
+    assert asha.on_result("c", 4, 150.0) == CONTINUE  # above
+    # min mode flips comparisons
+    asha_min = tune.ASHAScheduler(mode="min", max_t=64, grace_period=4, reduction_factor=2)
+    assert asha_min.on_result("a", 4, 1.0) == CONTINUE
+    assert asha_min.on_result("b", 4, 5.0) == STOP
+
+
+def _objective(config):
+    lr = config["lr"]
+    for step in range(1, 16):
+        tune.report({"score": lr * step, "step": step})
+        time.sleep(0.005)
+
+
+def test_grid_search_e2e(cluster):
+    tuner = tune.Tuner(
+        _objective,
+        param_space={"lr": tune.grid_search([0.1, 1.0, 5.0, 10.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max", max_concurrent_trials=4),
+        resources_per_trial={"CPU": 0.5},
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.config["lr"] == 10.0
+    assert best.metrics["score"] == 10.0 * 15
+    assert all(r.status == "TERMINATED" for r in grid)
+
+
+def test_asha_stops_underperformers_e2e(cluster):
+    """8 trials under ASHA: descending lr order guarantees later (worse)
+    trials fall below the rung cutoff and are killed early."""
+    asha = tune.ASHAScheduler(mode="max", max_t=16, grace_period=2, reduction_factor=2)
+    lrs = [16.0, 8.0, 4.0, 2.0, 1.0, 0.5, 0.2, 0.1]
+    tuner = tune.Tuner(
+        _objective,
+        param_space={"lr": tune.grid_search(lrs)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=asha, max_concurrent_trials=2
+        ),
+        resources_per_trial={"CPU": 0.5},
+    )
+    grid = tuner.fit()
+    assert len(grid) == 8
+    stopped = [r for r in grid if r.status == "STOPPED"]
+    assert stopped, "ASHA must early-stop underperformers"
+    assert grid.get_best_result().config["lr"] == 16.0
+    # the best trial ran to completion
+    assert next(r for r in grid if r.config["lr"] == 16.0).status == "TERMINATED"
+
+
+def test_errored_trial_reported(cluster):
+    def bad(config):
+        if config["x"] == 1:
+            raise ValueError("boom")
+        tune.report({"ok": 1})
+
+    grid = tune.Tuner(
+        bad,
+        param_space={"x": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+    ).fit()
+    assert len(grid.errors) == 1
+    assert "boom" in grid.errors[0].error
+    assert grid.get_best_result().config["x"] == 0
+
+
+def test_trainer_as_trainable(cluster):
+    """JaxTrainer launched per-trial: the variant config merges into the
+    train loop config (reference train/base_trainer.py:608)."""
+    from ray_tpu import train as rt_train
+    from ray_tpu.train import JaxBackendConfig, JaxTrainer, RunConfig, ScalingConfig
+
+    def train_fn(config):
+        rt_train.report({"loss": 1.0 / config["lr"]})
+
+    trainer = JaxTrainer(
+        train_fn,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        backend_config=JaxBackendConfig(distributed=False),
+        run_config=RunConfig(name="tune-trial"),
+    )
+    grid = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([1.0, 2.0, 4.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min", max_concurrent_trials=1),
+    ).fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.config["lr"] == 4.0
+    assert best.metrics["loss"] == 0.25
