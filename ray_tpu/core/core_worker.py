@@ -34,6 +34,7 @@ from ray_tpu.core.exceptions import (
     ObjectLostError,
     OwnerDiedError,
     RayTpuError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -86,6 +87,10 @@ class CoreWorker(RuntimeBackend):
         self._actor_queues: Dict[ActorID, Any] = {}
         self._pump_tasks: List[Any] = []
         self._stopping = False
+        # cancellation state (``CoreWorker::CancelTask``): task ids marked
+        # cancelled + where each inflight normal task currently executes
+        self._cancelled_tasks: set = set()
+        self._inflight_workers: Dict[bytes, Tuple[str, int]] = {}
 
         async def _setup():
             self.server = RpcServer()
@@ -254,45 +259,96 @@ class CoreWorker(RuntimeBackend):
         return value
 
     # ------------------------------------------------------------------
-    # wait
+    # wait — event-driven (reference ``raylet/wait_manager.h:25``): owned
+    # refs complete via ownership-table callbacks (no RPC, no polling);
+    # borrowed refs long-poll their owner's blocking get_object_status
+    # once instead of one RPC per 5ms tick per ref.
     def wait(self, refs, num_returns, timeout, fetch_local):
         deadline = None if timeout is None else time.monotonic() + timeout
 
-        async def _poll():
-            while True:
-                ready, not_ready = [], []
-                for r in refs:
-                    if await self._is_ready(r):
-                        ready.append(r)
-                    else:
-                        not_ready.append(r)
-                if len(ready) >= num_returns or (
-                    deadline is not None and time.monotonic() >= deadline
-                ):
-                    return ready, not_ready
-                await asyncio.sleep(0.005)
+        async def _wait_all():
+            done = [False] * len(refs)
 
-        ready, not_ready = self.io.run(_poll())
+            async def one(i: int, r: ObjectRef) -> None:
+                await self._wait_ready(r, deadline)
+                done[i] = True
+
+            tasks = [asyncio.ensure_future(one(i, r)) for i, r in enumerate(refs)]
+            try:
+                # One immediate pass first: timeout=0 must still observe
+                # refs that are already ready (their coroutines complete
+                # without suspending once scheduled).
+                await asyncio.wait(tasks, timeout=0)
+                while True:
+                    if sum(done) >= num_returns:
+                        break
+                    pending = [t for t in tasks if not t.done()]
+                    if not pending:
+                        break
+                    remaining = (
+                        None if deadline is None else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        break
+                    await asyncio.wait(
+                        pending,
+                        return_when=asyncio.FIRST_COMPLETED,
+                        timeout=remaining,
+                    )
+            finally:
+                for t in tasks:
+                    if not t.done():
+                        t.cancel()
+            ready = [r for i, r in enumerate(refs) if done[i]]
+            not_ready = [r for i, r in enumerate(refs) if not done[i]]
+            return ready, not_ready
+
+        ready, not_ready = self.io.run(_wait_all())
         if len(ready) > num_returns:
             not_ready = ready[num_returns:] + not_ready
             ready = ready[:num_returns]
         return ready, not_ready
 
-    async def _is_ready(self, ref: ObjectRef) -> bool:
+    async def _wait_ready(self, ref: ObjectRef, deadline: Optional[float]) -> None:
+        """Resolve when the ref is ready (or its owner is gone — get()
+        surfaces that error)."""
         oid = ref.id()
         if self.memory.contains(oid):
-            return True
+            return
         if self.refcounter.owns(oid):
-            obj = self.refcounter.get(oid)
-            return obj is not None and obj.ready()
-        try:
-            owner = self._owner_client(ref)
-            status = await owner.call(
-                "get_object_status", {"object_id": oid.binary(), "timeout": 0}, timeout=10
-            )
-            return status["status"] in ("inline", "locations", "error")
-        except Exception:
-            return True  # owner gone → get() will raise; count as "ready"
+            loop = asyncio.get_event_loop()
+            ev = asyncio.Event()
+            cb = lambda: loop.call_soon_threadsafe(ev.set)  # noqa: E731
+            if self.refcounter.on_ready(oid, cb):
+                return
+            try:
+                await ev.wait()
+            finally:
+                # timed-out/abandoned waiters must not leave closures
+                # accumulating on the object
+                self.refcounter.remove_ready_callback(oid, cb)
+            return
+        # borrowed: one blocking long-poll per step against the owner
+        owner = self._owner_client(ref)
+        while True:
+            step = 30.0
+            if deadline is not None:
+                step = max(0.0, min(step, deadline - time.monotonic()))
+            try:
+                status = await owner.call(
+                    "get_object_status",
+                    {"object_id": oid.binary(), "timeout": step},
+                    timeout=step + 10,
+                )
+            except Exception:
+                return  # owner gone → get() will raise; count as "ready"
+            if status["status"] in ("inline", "locations", "error", "unknown"):
+                # unknown == freed at the owner: get() raises, count ready
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                # caller's deadline: report not-ready by never resolving
+                # (the outer asyncio.wait timeout cuts us off)
+                await asyncio.sleep(3600)
 
     # ------------------------------------------------------------------
     # free / refcounting
@@ -410,19 +466,41 @@ class CoreWorker(RuntimeBackend):
 
     async def _submit_normal_inner(self, spec: TaskSpec) -> None:
         retries_left = spec.max_retries
+        tid = spec.task_id.binary()
         try:
             while True:
+                if tid in self._cancelled_tasks:
+                    self._fail_returns(spec, TaskCancelledError(spec.task_id.hex()[:16]))
+                    return
                 try:
                     grant = await self._acquire_lease(spec)
                 except RayTpuError as e:
                     self._fail_returns(spec, e)
                     return
+                if tid in self._cancelled_tasks:
+                    # cancelled while waiting for a lease: give it back
+                    try:
+                        await self._client(grant["daemon_host"], grant["daemon_port"]).call(
+                            "return_lease", {"lease_id": grant["lease_id"]}
+                        )
+                    except Exception:
+                        pass
+                    self._fail_returns(spec, TaskCancelledError(spec.task_id.hex()[:16]))
+                    return
                 logger.debug("task %s leased %s:%s", spec.name, grant["host"], grant["port"])
                 worker_client = self._client(grant["host"], grant["port"])
                 lease_daemon = self._client(grant["daemon_host"], grant["daemon_port"])
+                self._inflight_workers[tid] = (grant["host"], grant["port"])
                 try:
                     reply = await worker_client.call("push_task", {"spec": spec}, timeout=None, connect_timeout=3.0)
                 except ConnectionLost:
+                    if tid in self._cancelled_tasks:
+                        # force-cancel kills the worker: that drop IS the
+                        # cancellation, not a crash to retry
+                        self._fail_returns(
+                            spec, TaskCancelledError(spec.task_id.hex()[:16])
+                        )
+                        return
                     if retries_left > 0:
                         retries_left -= 1
                         logger.info("task %s worker died; retrying", spec.name)
@@ -432,6 +510,7 @@ class CoreWorker(RuntimeBackend):
                     )
                     return
                 finally:
+                    self._inflight_workers.pop(tid, None)
                     try:
                         await lease_daemon.call("return_lease", {"lease_id": grant["lease_id"]})
                     except Exception:
@@ -443,6 +522,7 @@ class CoreWorker(RuntimeBackend):
                     continue
                 return
         finally:
+            self._cancelled_tasks.discard(tid)
             self._unpin_deps(spec)
 
     async def _acquire_lease(self, spec: TaskSpec) -> Dict[str, Any]:
@@ -702,8 +782,55 @@ class CoreWorker(RuntimeBackend):
         )
 
     def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
-        # Round 1: cooperative cancellation of queued work only.
-        logger.warning("cancel() is best-effort in this version")
+        """Cancel the task producing ``ref`` (``CoreWorker::CancelTask``).
+
+        Queued tasks are failed with TaskCancelledError at the next
+        submission checkpoint; a running task gets the error raised in
+        its execution thread (cooperative — blocking C calls won't see
+        it); ``force=True`` kills the executing worker process. Actor
+        tasks are not cancellable (reference parity for sync actors)."""
+        oid = ref.id()
+        task_id = oid.task_id()
+        if oid.is_put():
+            raise ValueError("cannot cancel(): ref came from put(), not a task")
+        if not self.refcounter.owns(oid):
+            # Borrowed ref: submission state lives at the owner — forward
+            # (reference CancelTask routes through the owner).
+            owner = self._owner_client(ref)
+
+            async def _forward():
+                try:
+                    await owner.call(
+                        "cancel_owned_task",
+                        {"object_id": oid.binary(), "force": force},
+                        timeout=10,
+                    )
+                except Exception:
+                    pass  # owner gone → task is moot anyway
+
+            self.io.post(_forward())
+            return
+        self._cancel_owned(oid, force)
+
+    def _cancel_owned(self, oid: ObjectID, force: bool) -> None:
+        obj = self.refcounter.get(oid)
+        if obj is not None and obj.ready():
+            return  # already finished — nothing to cancel (reference no-op)
+        tid = oid.task_id().binary()
+        self._cancelled_tasks.add(tid)
+        target = self._inflight_workers.get(tid)
+        if target is not None:
+            host, port = target
+
+            async def _send():
+                try:
+                    await self._client(host, port).call(
+                        "cancel_task", {"task_id": tid, "force": force}, timeout=10
+                    )
+                except Exception:
+                    pass  # worker already gone
+
+            self.io.post(_send())
 
     def get_named_actor(self, name: str, namespace: str):
         info = self.io.run(
@@ -825,12 +952,22 @@ class CoreWorker(RuntimeBackend):
             if data is not None:
                 return {"status": "inline", "data": data}
             return {"status": "unknown"}
-        loop = asyncio.get_event_loop()
-        obj = (
-            self.refcounter.get(oid)
-            if timeout == 0
-            else await loop.run_in_executor(None, self.refcounter.wait_ready, oid, timeout)
-        )
+        # Event-driven long-poll: park on the io loop, NOT an executor
+        # thread — dozens of borrowers long-polling must not saturate the
+        # owner's thread pool (reference pubsub serves these from buffers).
+        obj = self.refcounter.get(oid)
+        if timeout != 0 and (obj is None or not obj.ready()):
+            loop = asyncio.get_event_loop()
+            ev = asyncio.Event()
+            cb = lambda: loop.call_soon_threadsafe(ev.set)  # noqa: E731
+            if not self.refcounter.on_ready(oid, cb):
+                try:
+                    await asyncio.wait_for(ev.wait(), timeout)
+                except (asyncio.TimeoutError, TimeoutError):
+                    pass
+                finally:
+                    self.refcounter.remove_ready_callback(oid, cb)
+            obj = self.refcounter.get(oid)
         if obj is None:
             return {"status": "unknown"}
         if obj.state == ObjState.FAILED:
@@ -840,6 +977,17 @@ class CoreWorker(RuntimeBackend):
         if obj.inline is not None:
             return {"status": "inline", "data": obj.inline}
         return {"status": "locations", "locations": list(obj.locations)}
+
+    async def w_cancel_task(self, payload, conn):
+        """Cancel an executing/queued task on this worker."""
+        if self.executor is None:
+            return False
+        return self.executor.cancel_task(payload["task_id"], payload.get("force", False))
+
+    async def w_cancel_owned_task(self, payload, conn):
+        """Borrower-forwarded cancel: this process owns the target ref."""
+        self._cancel_owned(ObjectID(payload["object_id"]), payload.get("force", False))
+        return True
 
     async def w_recover_object(self, payload, conn):
         """Borrower-initiated lineage reconstruction: a borrower failed to

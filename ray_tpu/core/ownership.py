@@ -51,6 +51,9 @@ class OwnedObject:
     contained: List[Any] = field(default_factory=list)
     lineage: Optional[Any] = None  # producing TaskSpec (reconstruction)
     waiters: List[threading.Event] = field(default_factory=list)
+    #: one-shot callbacks fired (then dropped) on the next completion —
+    #: the event-driven wait() path (``raylet/wait_manager.h:25``)
+    ready_callbacks: List[Callable[[], None]] = field(default_factory=list)
     # lineage reconstruction bookkeeping (``object_recovery_manager.h:90``)
     recovering: bool = False
     reconstructions_left: int = -1  # -1 = not yet initialized from config
@@ -144,6 +147,12 @@ class ReferenceCounter:
         for ev in obj.waiters:
             ev.set()
         obj.waiters.clear()
+        for cb in obj.ready_callbacks:
+            try:
+                cb()
+            except Exception:
+                logger.exception("ready callback failed")
+        obj.ready_callbacks.clear()
 
     # -- queries ---------------------------------------------------------
     def get(self, object_id: ObjectID) -> Optional[OwnedObject]:
@@ -168,6 +177,29 @@ class ReferenceCounter:
             return None
         with self._lock:
             return self._objects.get(object_id)
+
+    def on_ready(self, object_id: ObjectID, callback: Callable[[], None]) -> bool:
+        """Register a one-shot completion callback. Returns True if the
+        object is ALREADY ready (or unknown/freed — the waiter should
+        treat that as ready and let get() surface the error); in that
+        case the callback is NOT registered."""
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None or obj.ready():
+                return True
+            obj.ready_callbacks.append(callback)
+            return False
+
+    def remove_ready_callback(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
+        """Deregister a callback whose waiter gave up (timed-out wait) —
+        otherwise repeated waits on a slow object accumulate closures."""
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                try:
+                    obj.ready_callbacks.remove(callback)
+                except ValueError:
+                    pass
 
     def add_location(self, object_id: ObjectID, node_id: bytes) -> None:
         with self._lock:

@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import execution, serialization
 from ray_tpu.core.config import GLOBAL_CONFIG
-from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.exceptions import TaskCancelledError, TaskError
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.task_spec import TaskKind, TaskSpec
 
@@ -42,6 +42,11 @@ class TaskExecutor:
         self._seq: Dict[bytes, Dict[str, Any]] = {}
         self._async_loop: Optional[asyncio.AbstractEventLoop] = None
         self._async_sem: Optional[asyncio.Semaphore] = None
+        # cancellation (``CoreWorker::CancelTask``): ids cancelled before
+        # execution start + thread idents of tasks currently executing
+        self._cancelled: set = set()
+        self._running_threads: Dict[bytes, int] = {}
+        self._cancel_lock = threading.Lock()
 
     def bind(self, core, api_worker) -> None:
         self.core = core
@@ -111,10 +116,34 @@ class TaskExecutor:
         if spec.kind == TaskKind.ACTOR_TASK:
             return await self._handle_actor_task(spec)
         logger.debug("executing %s %s", spec.name, spec.task_id.hex()[:8])
-        loop = asyncio.get_event_loop()
-        results = await loop.run_in_executor(self._default_lane, self._execute, spec)
+        # Normal tasks run on a DEDICATED thread, not a pool: cancel_task
+        # delivers TaskCancelledError via PyThreadState_SetAsyncExc, and an
+        # exception that fires after the task finished must land in a
+        # dying throwaway thread — never in a pooled thread where it would
+        # poison the next task or kill the pool worker (hanging the lane).
+        results = await self._run_on_fresh_thread(self._execute, spec)
         logger.debug("finished %s %s", spec.name, spec.task_id.hex()[:8])
         return {"results": results}
+
+    @staticmethod
+    async def _run_on_fresh_thread(fn, *args):
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+
+        def _runner():
+            try:
+                res = fn(*args)
+            except BaseException as e:  # noqa: BLE001
+                loop.call_soon_threadsafe(
+                    lambda: fut.set_exception(e) if not fut.done() else None
+                )
+            else:
+                loop.call_soon_threadsafe(
+                    lambda: fut.set_result(res) if not fut.done() else None
+                )
+
+        threading.Thread(target=_runner, daemon=True, name="task-exec").start()
+        return await fut
 
     async def _handle_actor_task(self, spec: TaskSpec) -> Dict[str, Any]:
         # built-in methods
@@ -221,25 +250,79 @@ class TaskExecutor:
                 args={"task_id": spec.task_id.hex()[:16]},
             )
 
+    def cancel_task(self, task_id: bytes, force: bool) -> bool:
+        """Cooperative (or forced) cancellation (``CoreWorker::CancelTask``).
+
+        Queued tasks are marked and rejected at the dep-resolution /
+        execution boundary; a RUNNING task gets TaskCancelledError raised
+        asynchronously in its lane thread; ``force`` exits the worker
+        process (the daemon reaps it, the submitter sees the connection
+        drop)."""
+        if force:
+            self.core.io.loop.call_later(0.05, _exit_now)
+            return True
+        with self._cancel_lock:
+            self._cancelled.add(task_id)
+            ident = self._running_threads.get(task_id)
+        if ident is not None:
+            import ctypes
+
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError)
+            )
+        return True
+
     def _execute_inner(self, spec: TaskSpec) -> List[Tuple[bytes, str, Any]]:
         self.api_worker.job_id = spec.job_id
         self.api_worker.set_task_context(spec.task_id, spec.job_id)
+        tid = spec.task_id.binary()
+        with self._cancel_lock:
+            if tid in self._cancelled:
+                err = TaskCancelledError(spec.task_id.hex()[:16])
+                return [
+                    (oid.binary(), "error", pickle.dumps(err))
+                    for oid in spec.return_ids
+                ]
+            if spec.kind != TaskKind.ACTOR_TASK:
+                # only normal tasks are async-exc cancellable: they run on
+                # dedicated throwaway threads (actor tasks share pooled
+                # lane threads where a stray exception would poison peers)
+                self._running_threads[tid] = threading.get_ident()
         try:
-            if spec.kind == TaskKind.ACTOR_TASK:
-                fn = getattr(self._actor_instance, spec.method_name)
-            else:
-                fn = self.api_worker.fn_table.load(spec.function_id)
-            args, kwargs = execution.resolve_args(spec, self._get_dep)
-        except Exception as e:  # noqa: BLE001
-            err = e if isinstance(e, TaskError) else TaskError(spec.name, e)
-            return [(oid.binary(), "error", pickle.dumps(err)) for oid in spec.return_ids]
-        pairs = execution.run_function(spec, fn, args, kwargs)
-        return self._package(spec, pairs)
+            try:
+                if spec.kind == TaskKind.ACTOR_TASK:
+                    fn = getattr(self._actor_instance, spec.method_name)
+                else:
+                    fn = self.api_worker.fn_table.load(spec.function_id)
+                args, kwargs = execution.resolve_args(spec, self._get_dep)
+            except TaskCancelledError:
+                err = TaskCancelledError(spec.task_id.hex()[:16])
+                return [
+                    (oid.binary(), "error", pickle.dumps(err))
+                    for oid in spec.return_ids
+                ]
+            except Exception as e:  # noqa: BLE001
+                err = e if isinstance(e, TaskError) else TaskError(spec.name, e)
+                return [(oid.binary(), "error", pickle.dumps(err)) for oid in spec.return_ids]
+            pairs = execution.run_function(spec, fn, args, kwargs)
+        finally:
+            with self._cancel_lock:
+                self._running_threads.pop(tid, None)
+        # An async-raised TaskCancelledError lands as the TaskError cause:
+        # surface it as the cancellation itself, not an app failure.
+        out: List[Tuple[ObjectID, Any]] = []
+        for oid, value in pairs:
+            if isinstance(value, TaskError) and isinstance(
+                getattr(value, "cause", None), TaskCancelledError
+            ):
+                value = TaskCancelledError(spec.task_id.hex()[:16])
+            out.append((oid, value))
+        return self._package(spec, out)
 
     def _package(self, spec: TaskSpec, pairs: List[Tuple[ObjectID, Any]]) -> List[Tuple[bytes, str, Any]]:
         out: List[Tuple[bytes, str, Any]] = []
         for oid, value in pairs:
-            if isinstance(value, TaskError):
+            if isinstance(value, (TaskError, TaskCancelledError)):
                 out.append((oid.binary(), "error", pickle.dumps(value)))
                 continue
             try:

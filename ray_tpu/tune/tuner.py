@@ -166,22 +166,38 @@ class Tuner:
             for i, v in enumerate(variants)
         ]
         pending = list(trials)
+        launching: List[tuple] = []  # (trial, run_ref): actor may be queued
         running: List[Trial] = []
         opts = dict(self.resources_per_trial)
         num_cpus = opts.pop("CPU", 1.0)
 
-        while pending or running:
-            while pending and len(running) < cfg.max_concurrent_trials:
+        while pending or launching or running:
+            while pending and len(launching) + len(running) < cfg.max_concurrent_trials:
                 t = pending.pop(0)
                 t.actor = TrialRunner.options(
                     num_cpus=num_cpus, resources=opts or None
                 ).remote()
-                ray_tpu.get(
-                    t.actor.run.remote(self._trainable, t.config, t.trial_id),
-                    timeout=120,
-                )
-                t.status = RUNNING
-                running.append(t)
+                # Fire-and-track: the actor may wait arbitrarily long for
+                # cluster capacity — a blocking get() here would stall the
+                # poll loop (frozen ASHA decisions) and crash the sweep on
+                # an oversubscribed cluster.
+                launching.append((t, t.actor.run.remote(self._trainable, t.config, t.trial_id)))
+
+            still_launching: List[tuple] = []
+            for t, run_ref in launching:
+                done, _ = ray_tpu.wait([run_ref], num_returns=1, timeout=0)
+                if not done:
+                    still_launching.append((t, run_ref))
+                    continue
+                try:
+                    ray_tpu.get(run_ref, timeout=10)
+                    t.status = RUNNING
+                    running.append(t)
+                except Exception as e:  # noqa: BLE001
+                    t.status = ERRORED
+                    t.error = f"trial actor failed to start: {e!r}"
+                    scheduler.on_trial_complete(t.trial_id)
+            launching = still_launching
 
             still_running: List[Trial] = []
             for t in running:
@@ -223,7 +239,7 @@ class Tuner:
                 else:
                     still_running.append(t)
             running = still_running
-            if pending or running:
+            if pending or launching or running:
                 time.sleep(0.02)
 
         return ResultGrid(

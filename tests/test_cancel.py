@@ -1,0 +1,139 @@
+"""Task cancellation (reference ``CoreWorker::CancelTask``) + the
+event-driven wait path."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_cancel_queued_task(cluster):
+    """Tasks still waiting for a lease fail fast with TaskCancelledError."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def hog():
+        time.sleep(8)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=1)
+    def queued():
+        return "ran"
+
+    hogs = [hog.remote() for _ in range(2)]  # occupy both CPUs
+    time.sleep(0.5)
+    victim = queued.remote()  # stuck waiting for a lease
+    ray_tpu.cancel(victim)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(victim, timeout=30)
+    assert ray_tpu.get(hogs, timeout=60) == ["done", "done"]
+
+
+def test_cancel_running_task_cooperative(cluster):
+    """A running pure-Python loop gets TaskCancelledError raised in its
+    execution thread."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def spin():
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            time.sleep(0.01)  # bytecode boundary for the async exception
+        return "survived"
+
+    ref = spin.remote()
+    time.sleep(1.5)  # let it start executing
+    ray_tpu.cancel(ref)
+    t0 = time.time()
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+    assert time.time() - t0 < 20  # cancelled, not run to completion
+
+
+def test_cancel_running_task_force(cluster):
+    """force=True kills the executing worker process."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def stuck():
+        time.sleep(60)
+        return "survived"
+
+    ref = stuck.remote()
+    time.sleep(1.5)
+    ray_tpu.cancel(ref, force=True)
+    t0 = time.time()
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+    assert time.time() - t0 < 20
+
+
+def test_cancel_put_ref_rejected(cluster):
+    ref = ray_tpu.put(123)
+    with pytest.raises(ValueError):
+        ray_tpu.cancel(ref)
+
+
+def test_cancel_finished_task_noop(cluster):
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=60) == 7
+    ray_tpu.cancel(ref)  # no-op
+    assert ray_tpu.get(ref, timeout=60) == 7
+
+
+def test_wait_wakes_promptly(cluster):
+    """Event-driven wait: completion wakes the waiter without polling
+    delay; unfinished refs stay not-ready."""
+
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote(num_cpus=0)
+    def slow():
+        time.sleep(10)
+        return 2
+
+    s = slow.remote()
+    f = fast.remote()
+    ready, not_ready = ray_tpu.wait([s, f], num_returns=1, timeout=30)
+    assert ready == [f] and not_ready == [s]
+    # timeout path: nothing ready
+    ready2, not_ready2 = ray_tpu.wait([s], num_returns=1, timeout=0.2)
+    assert ready2 == [] and not_ready2 == [s]
+
+
+def test_cancel_borrowed_ref_forwards_to_owner(cluster):
+    """A borrower (actor) cancelling a driver-owned task forwards the
+    cancel to the owner (reference CancelTask owner routing)."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def spin():
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            time.sleep(0.01)
+        return "survived"
+
+    @ray_tpu.remote(num_cpus=0)
+    class Canceller:
+        def cancel_it(self, refs):
+            ray_tpu.cancel(refs[0])
+            return True
+
+    ref = spin.remote()
+    time.sleep(1.0)
+    c = Canceller.remote()
+    assert ray_tpu.get(c.cancel_it.remote([ref]), timeout=30)
+    t0 = time.time()
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+    assert time.time() - t0 < 20
