@@ -53,6 +53,14 @@ class GlobalConfig:
     lineage_pinning_enabled: bool = True
     #: resubmission attempts per lost object (``task_manager.h:273``)
     max_lineage_reconstructions: int = 3
+    #: concurrent worker leases per scheduling class (lease pipelining,
+    #: ``normal_task_submitter.cc:351``)
+    max_lease_pumps: int = 16
+    #: how long an idle held lease waits for more same-class work before
+    #: being returned
+    lease_linger_s: float = 0.02
+    #: specs per push RPC on a held lease (serial worker-side execution)
+    lease_push_batch: int = 8
 
     # --- RPC ---
     rpc_connect_timeout_s: float = 10.0

@@ -22,6 +22,7 @@ import logging
 import pickle
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core import serialization
@@ -46,6 +47,20 @@ from ray_tpu.core.rpc import ConnectionLost, IoThread, RpcClient, RpcServer
 from ray_tpu.core.task_spec import TaskKind, TaskSpec
 
 logger = logging.getLogger(__name__)
+
+
+class _ClassQueue:
+    """Pending normal tasks of one scheduling class + active pump count."""
+
+    __slots__ = ("specs", "pumps", "work")
+
+    def __init__(self):
+        import asyncio as _asyncio
+        from collections import deque
+
+        self.specs = deque()
+        self.pumps = 0
+        self.work = _asyncio.Event()  # set on enqueue: wakes lingering pumps
 
 
 class _ActorState:
@@ -88,9 +103,14 @@ class CoreWorker(RuntimeBackend):
         self._pump_tasks: List[Any] = []
         self._stopping = False
         # cancellation state (``CoreWorker::CancelTask``): task ids marked
-        # cancelled + where each inflight normal task currently executes
-        self._cancelled_tasks: set = set()
+        # cancelled + where each inflight normal task currently executes.
+        # Bounded FIFO: cancels of actor tasks / already-freed refs have no
+        # finalize path to reclaim their entries.
+        self._cancelled_tasks: "OrderedDict[bytes, None]" = OrderedDict()
         self._inflight_workers: Dict[bytes, Tuple[str, int]] = {}
+        # lease-reuse submission (per scheduling class)
+        self._class_queues: Dict[Any, "_ClassQueue"] = {}
+        self._retries_left: Dict[bytes, int] = {}
 
         async def _setup():
             self.server = RpcServer()
@@ -276,9 +296,18 @@ class CoreWorker(RuntimeBackend):
             tasks = [asyncio.ensure_future(one(i, r)) for i, r in enumerate(refs)]
             try:
                 # One immediate pass first: timeout=0 must still observe
-                # refs that are already ready (their coroutines complete
-                # without suspending once scheduled).
-                await asyncio.wait(tasks, timeout=0)
+                # refs that are already ready. Owned refs resolve without
+                # suspending; borrowed refs need one status round-trip to
+                # the owner, so grant them a short window — otherwise a
+                # timeout=0 poll loop would NEVER see a ready borrowed ref.
+                borrowed = any(
+                    not self.refcounter.owns(r.id()) and not self.memory.contains(r.id())
+                    for r in refs
+                )
+                expired = deadline is not None and time.monotonic() >= deadline
+                await asyncio.wait(
+                    tasks, timeout=0.2 if (borrowed and expired) else 0
+                )
                 while True:
                     if sum(done) >= num_returns:
                         break
@@ -411,7 +440,7 @@ class CoreWorker(RuntimeBackend):
         for oid in spec.return_ids:
             self.refcounter.create_pending(oid, lineage=spec, hold=True)
         self._pin_deps(spec)
-        self.io.post(self._submit_normal(spec))
+        self.io.post(self._enqueue_normal(spec))
 
     def _try_recover(self, oid: ObjectID, observed_locations=None) -> bool:
         """Lineage reconstruction (``object_recovery_manager.h:90``): if
@@ -444,7 +473,7 @@ class CoreWorker(RuntimeBackend):
                 _nid, host, port = loc
                 self.io.post(self._delete_remote(host, port, ret_id))
         self._pin_deps(spec)
-        self.io.post(self._submit_normal(spec))
+        self.io.post(self._enqueue_normal(spec))
         return True
 
     def _pin_deps(self, spec: TaskSpec) -> None:
@@ -457,73 +486,183 @@ class CoreWorker(RuntimeBackend):
             if self.refcounter.owns(ref.id()):
                 self.refcounter.remove_submitted(ref.id())
 
-    async def _submit_normal(self, spec: TaskSpec) -> None:
-        try:
-            await self._submit_normal_inner(spec)
-        except Exception as e:  # noqa: BLE001 — never leave returns pending
-            logger.exception("task %s submission failed", spec.name)
-            self._fail_returns(spec, e if isinstance(e, RayTpuError) else RayTpuError(repr(e)))
+    # Lease reuse (reference lease pipelining,
+    # ``transport/normal_task_submitter.cc:351``): tasks queue per
+    # *scheduling class* (resources + strategy); each class runs up to
+    # max_lease_pumps pump coroutines, and a pump holds ONE worker lease,
+    # pushing queued task after queued task onto it — the request/return
+    # lease round-trips amortize across the whole queue instead of being
+    # paid per task.
+    def _sched_class_key(self, spec: TaskSpec):
+        return (
+            tuple(sorted(spec.resources.items())),
+            repr(spec.scheduling_strategy),
+        )
 
-    async def _submit_normal_inner(self, spec: TaskSpec) -> None:
-        retries_left = spec.max_retries
-        tid = spec.task_id.binary()
+    async def _enqueue_normal(self, spec: TaskSpec) -> None:
+        key = self._sched_class_key(spec)
+        q = self._class_queues.get(key)
+        if q is None:
+            q = self._class_queues[key] = _ClassQueue()
+        q.specs.append(spec)
+        q.work.set()
+        self._retries_left[spec.task_id.binary()] = spec.max_retries
+        if q.pumps < min(GLOBAL_CONFIG.max_lease_pumps, len(q.specs)):
+            q.pumps += 1
+            if len(self._pump_tasks) > 64:
+                self._pump_tasks = [t for t in self._pump_tasks if not t.done()]
+            self._pump_tasks.append(
+                asyncio.ensure_future(self._pump_class(key, q, spec))
+            )
+
+    async def _pump_class(self, key, q: "_ClassQueue", template: TaskSpec) -> None:
         try:
-            while True:
-                if tid in self._cancelled_tasks:
-                    self._fail_returns(spec, TaskCancelledError(spec.task_id.hex()[:16]))
+            while q.specs:
+                try:
+                    grant = await self._acquire_lease(template)
+                except RayTpuError as e:
+                    # class-wide failure (infeasible / lease timeout):
+                    # fail everything currently queued for this class
+                    while q.specs:
+                        s = q.specs.popleft()
+                        self._finalize_spec(s, error=e)
                     return
                 try:
-                    grant = await self._acquire_lease(spec)
-                except RayTpuError as e:
-                    self._fail_returns(spec, e)
-                    return
-                if tid in self._cancelled_tasks:
-                    # cancelled while waiting for a lease: give it back
+                    await self._drain_on_lease(q, grant)
+                finally:
                     try:
-                        await self._client(grant["daemon_host"], grant["daemon_port"]).call(
-                            "return_lease", {"lease_id": grant["lease_id"]}
-                        )
+                        await self._client(
+                            grant["daemon_host"], grant["daemon_port"]
+                        ).call("return_lease", {"lease_id": grant["lease_id"]})
                     except Exception:
                         pass
-                    self._fail_returns(spec, TaskCancelledError(spec.task_id.hex()[:16]))
-                    return
-                logger.debug("task %s leased %s:%s", spec.name, grant["host"], grant["port"])
-                worker_client = self._client(grant["host"], grant["port"])
-                lease_daemon = self._client(grant["daemon_host"], grant["daemon_port"])
-                self._inflight_workers[tid] = (grant["host"], grant["port"])
+        except Exception:  # noqa: BLE001 — never leave returns pending
+            logger.exception("class pump failed")
+            while q.specs:
+                s = q.specs.popleft()
+                self._finalize_spec(s, error=RayTpuError("submission pump failed"))
+        finally:
+            q.pumps -= 1
+            if q.pumps == 0 and not q.specs:
+                self._class_queues.pop(key, None)
+
+    async def _drain_on_lease(self, q: "_ClassQueue", grant: Dict[str, Any]) -> None:
+        """Push queued specs onto one held lease until the queue runs dry
+        (with a short linger for stragglers) or the worker dies."""
+        worker_client = self._client(grant["host"], grant["port"])
+        while True:
+            if not q.specs:
+                # Linger: hold the lease briefly for follow-on work, but
+                # wake IMMEDIATELY when something is enqueued (a plain
+                # sleep would add up to linger_s of latency per task on
+                # serial submit-get-submit callers).
+                q.work.clear()
                 try:
-                    reply = await worker_client.call("push_task", {"spec": spec}, timeout=None, connect_timeout=3.0)
-                except ConnectionLost:
+                    await asyncio.wait_for(
+                        q.work.wait(), GLOBAL_CONFIG.lease_linger_s
+                    )
+                except (asyncio.TimeoutError, TimeoutError):
+                    pass
+                if not q.specs:
+                    return
+            # Pop a small batch: one RPC carries several specs (executed
+            # serially worker-side), amortizing framing + syscalls.
+            # ADAPTIVE size: batch only when the queue floods faster than
+            # the pumps drain — with few tasks per pump the batch is 1,
+            # preserving cross-worker parallelism for long tasks (and
+            # keeping force-cancel's worker kill from taking batchmates
+            # down with it).
+            limit = max(
+                1,
+                min(
+                    GLOBAL_CONFIG.lease_push_batch,
+                    (len(q.specs) + 1) // max(1, q.pumps),
+                ),
+            )
+            batch: List[TaskSpec] = []
+            while q.specs and len(batch) < limit:
+                spec = q.specs.popleft()
+                tid = spec.task_id.binary()
+                if tid in self._cancelled_tasks:
+                    self._finalize_spec(
+                        spec, error=TaskCancelledError(spec.task_id.hex()[:16])
+                    )
+                    continue
+                batch.append(spec)
+            if not batch:
+                continue
+            for spec in batch:
+                self._inflight_workers[spec.task_id.binary()] = (
+                    grant["host"],
+                    grant["port"],
+                )
+            try:
+                reply = await worker_client.call(
+                    "push_batch",
+                    {"specs": batch},
+                    timeout=None,
+                    connect_timeout=3.0,
+                )
+            except ConnectionLost:
+                for spec in batch:
+                    tid = spec.task_id.binary()
                     if tid in self._cancelled_tasks:
                         # force-cancel kills the worker: that drop IS the
                         # cancellation, not a crash to retry
-                        self._fail_returns(
-                            spec, TaskCancelledError(spec.task_id.hex()[:16])
+                        self._finalize_spec(
+                            spec, error=TaskCancelledError(spec.task_id.hex()[:16])
                         )
-                        return
-                    if retries_left > 0:
-                        retries_left -= 1
+                    elif self._retries_left.get(tid, 0) > 0:
+                        self._retries_left[tid] -= 1
                         logger.info("task %s worker died; retrying", spec.name)
-                        continue
-                    self._fail_returns(
-                        spec, WorkerCrashedError(f"worker died executing {spec.name}")
+                        q.specs.appendleft(spec)
+                    else:
+                        self._finalize_spec(
+                            spec,
+                            error=WorkerCrashedError(
+                                f"worker died executing {spec.name}"
+                            ),
+                        )
+                return  # lease is dead
+            except Exception as e:  # noqa: BLE001
+                # Non-transport failure (e.g. worker-side packaging error
+                # surfaced as RemoteError): the batch's returns must never
+                # be left PENDING forever.
+                logger.exception("push_batch failed")
+                for spec in batch:
+                    self._finalize_spec(
+                        spec,
+                        error=e if isinstance(e, RayTpuError) else RayTpuError(repr(e)),
                     )
-                    return
-                finally:
-                    self._inflight_workers.pop(tid, None)
-                    try:
-                        await lease_daemon.call("return_lease", {"lease_id": grant["lease_id"]})
-                    except Exception:
-                        pass
-                logger.debug("task %s reply received", spec.name)
-                retry = self._process_reply(spec, reply, retries_left)
-                if retry:
-                    retries_left -= 1
-                    continue
                 return
-        finally:
-            self._cancelled_tasks.discard(tid)
-            self._unpin_deps(spec)
+            finally:
+                for spec in batch:
+                    self._inflight_workers.pop(spec.task_id.binary(), None)
+            for spec, one_reply in zip(batch, reply["replies"]):
+                tid = spec.task_id.binary()
+                try:
+                    retry = self._process_reply(
+                        spec, one_reply, self._retries_left.get(tid, 0)
+                    )
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("reply processing failed for %s", spec.name)
+                    self._finalize_spec(spec, error=RayTpuError(repr(e)))
+                    continue
+                if retry:
+                    self._retries_left[tid] -= 1
+                    q.specs.appendleft(spec)
+                else:
+                    self._finalize_spec(spec)
+
+    def _finalize_spec(self, spec: TaskSpec, error: Optional[Exception] = None) -> None:
+        """A spec leaves the submission system: record failure (if any),
+        release dep pins and cancellation/retry bookkeeping."""
+        if error is not None:
+            self._fail_returns(spec, error)
+        tid = spec.task_id.binary()
+        self._cancelled_tasks.pop(tid, None)
+        self._retries_left.pop(tid, None)
+        self._unpin_deps(spec)
 
     async def _acquire_lease(self, spec: TaskSpec) -> Dict[str, Any]:
         """Lease with spillback-following (reference lease protocol).
@@ -817,7 +956,9 @@ class CoreWorker(RuntimeBackend):
         if obj is not None and obj.ready():
             return  # already finished — nothing to cancel (reference no-op)
         tid = oid.task_id().binary()
-        self._cancelled_tasks.add(tid)
+        self._cancelled_tasks[tid] = None
+        while len(self._cancelled_tasks) > 8192:
+            self._cancelled_tasks.popitem(last=False)
         target = self._inflight_workers.get(tid)
         if target is not None:
             host, port = target
@@ -1026,6 +1167,16 @@ class CoreWorker(RuntimeBackend):
         return True
 
     # execution services are registered when an executor is attached
+    async def w_push_batch(self, payload, conn):
+        """Batched task push on a held lease: specs execute serially,
+        one framed reply (lease-pipelining companion)."""
+        if self.executor is None:
+            raise RuntimeError("this process does not execute tasks")
+        replies = []
+        for spec in payload["specs"]:
+            replies.append(await self.executor.handle_push_task(spec))
+        return {"replies": replies}
+
     async def w_push_task(self, payload, conn):
         if self.executor is None:
             raise RuntimeError("this process does not execute tasks")

@@ -116,34 +116,17 @@ class TaskExecutor:
         if spec.kind == TaskKind.ACTOR_TASK:
             return await self._handle_actor_task(spec)
         logger.debug("executing %s %s", spec.name, spec.task_id.hex()[:8])
-        # Normal tasks run on a DEDICATED thread, not a pool: cancel_task
-        # delivers TaskCancelledError via PyThreadState_SetAsyncExc, and an
-        # exception that fires after the task finished must land in a
-        # dying throwaway thread — never in a pooled thread where it would
-        # poison the next task or kill the pool worker (hanging the lane).
-        results = await self._run_on_fresh_thread(self._execute, spec)
+        # Normal tasks run on the pooled lane (thread spawn per task costs
+        # real throughput). Cancellation safety: cancel_task delivers
+        # TaskCancelledError via PyThreadState_SetAsyncExc and immediately
+        # RETIRES the lane (fresh pool) — a stray exception firing after
+        # the task finished lands in the abandoned pool's thread, never in
+        # a later task. The lane holds at most the one running task (the
+        # lease protocol serializes pushes), so nothing queued is lost.
+        loop = asyncio.get_event_loop()
+        results = await loop.run_in_executor(self._default_lane, self._execute, spec)
         logger.debug("finished %s %s", spec.name, spec.task_id.hex()[:8])
         return {"results": results}
-
-    @staticmethod
-    async def _run_on_fresh_thread(fn, *args):
-        loop = asyncio.get_event_loop()
-        fut = loop.create_future()
-
-        def _runner():
-            try:
-                res = fn(*args)
-            except BaseException as e:  # noqa: BLE001
-                loop.call_soon_threadsafe(
-                    lambda: fut.set_exception(e) if not fut.done() else None
-                )
-            else:
-                loop.call_soon_threadsafe(
-                    lambda: fut.set_result(res) if not fut.done() else None
-                )
-
-        threading.Thread(target=_runner, daemon=True, name="task-exec").start()
-        return await fut
 
     async def _handle_actor_task(self, spec: TaskSpec) -> Dict[str, Any]:
         # built-in methods
@@ -267,6 +250,12 @@ class TaskExecutor:
         if ident is not None:
             import ctypes
 
+            # Retire the lane BEFORE delivering: if the exception fires
+            # after the task completes, it lands in the abandoned pool's
+            # (now-idle) thread instead of poisoning the next task.
+            self._default_lane = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="exec"
+            )
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
                 ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError)
             )
@@ -278,11 +267,14 @@ class TaskExecutor:
         tid = spec.task_id.binary()
         with self._cancel_lock:
             if tid in self._cancelled:
+                self._cancelled.discard(tid)  # consumed — don't grow forever
                 err = TaskCancelledError(spec.task_id.hex()[:16])
                 return [
                     (oid.binary(), "error", pickle.dumps(err))
                     for oid in spec.return_ids
                 ]
+            if len(self._cancelled) > 4096:
+                self._cancelled.clear()  # stale marks on a long-lived worker
             if spec.kind != TaskKind.ACTOR_TASK:
                 # only normal tasks are async-exc cancellable: they run on
                 # dedicated throwaway threads (actor tasks share pooled

@@ -197,6 +197,10 @@ class Tuner:
                     t.status = ERRORED
                     t.error = f"trial actor failed to start: {e!r}"
                     scheduler.on_trial_complete(t.trial_id)
+                    try:
+                        ray_tpu.kill(t.actor)  # release its reservation
+                    except Exception:
+                        pass
             launching = still_launching
 
             still_running: List[Trial] = []
