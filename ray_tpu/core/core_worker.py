@@ -305,9 +305,24 @@ class CoreWorker(RuntimeBackend):
                     for r in refs
                 )
                 expired = deadline is not None and time.monotonic() >= deadline
-                await asyncio.wait(
-                    tasks, timeout=0.2 if (borrowed and expired) else 0
-                )
+                if borrowed and expired:
+                    # grant borrowed refs one status round-trip, but stop
+                    # the moment num_returns is satisfied (an ALL_COMPLETED
+                    # wait would burn the whole window even when an owned
+                    # ref is already ready)
+                    end = time.monotonic() + 0.2
+                    while sum(done) < num_returns:
+                        pend = [t for t in tasks if not t.done()]
+                        left = end - time.monotonic()
+                        if not pend or left <= 0:
+                            break
+                        await asyncio.wait(
+                            pend,
+                            timeout=left,
+                            return_when=asyncio.FIRST_COMPLETED,
+                        )
+                else:
+                    await asyncio.wait(tasks, timeout=0)
                 while True:
                     if sum(done) >= num_returns:
                         break
@@ -638,11 +653,19 @@ class CoreWorker(RuntimeBackend):
             finally:
                 for spec in batch:
                     self._inflight_workers.pop(spec.task_id.binary(), None)
-            for spec, one_reply in zip(batch, reply["replies"]):
+            replies = reply["replies"]
+            for i, spec in enumerate(batch):
+                if i >= len(replies):
+                    # defensive: a short reply list must not strand the
+                    # tail's returns in PENDING forever
+                    self._finalize_spec(
+                        spec, error=RayTpuError("push_batch reply truncated")
+                    )
+                    continue
                 tid = spec.task_id.binary()
                 try:
                     retry = self._process_reply(
-                        spec, one_reply, self._retries_left.get(tid, 0)
+                        spec, replies[i], self._retries_left.get(tid, 0)
                     )
                 except Exception as e:  # noqa: BLE001
                     logger.exception("reply processing failed for %s", spec.name)
@@ -1169,12 +1192,27 @@ class CoreWorker(RuntimeBackend):
     # execution services are registered when an executor is attached
     async def w_push_batch(self, payload, conn):
         """Batched task push on a held lease: specs execute serially,
-        one framed reply (lease-pipelining companion)."""
+        one framed reply (lease-pipelining companion). Per-spec isolation:
+        one task's packaging failure becomes ITS error reply — it must
+        not discard batchmates' already-computed results by failing the
+        whole RPC."""
         if self.executor is None:
             raise RuntimeError("this process does not execute tasks")
         replies = []
         for spec in payload["specs"]:
-            replies.append(await self.executor.handle_push_task(spec))
+            try:
+                replies.append(await self.executor.handle_push_task(spec))
+            except Exception as e:  # noqa: BLE001
+                logger.exception("task %s failed in batch", spec.name)
+                err = TaskError(spec.name, e)
+                replies.append(
+                    {
+                        "results": [
+                            (oid.binary(), "error", pickle.dumps(err))
+                            for oid in spec.return_ids
+                        ]
+                    }
+                )
         return {"replies": replies}
 
     async def w_push_task(self, payload, conn):

@@ -253,9 +253,13 @@ class TaskExecutor:
             # Retire the lane BEFORE delivering: if the exception fires
             # after the task completes, it lands in the abandoned pool's
             # (now-idle) thread instead of poisoning the next task.
+            # shutdown(wait=False) wakes an idle old thread so it exits
+            # rather than parking forever with the exc pending.
+            old = self._default_lane
             self._default_lane = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="exec"
             )
+            old.shutdown(wait=False)
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
                 ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError)
             )

@@ -1,0 +1,176 @@
+"""ray_tpu.serve — model serving: deployments, replicas, routing, HTTP.
+
+Reference: ``python/ray/serve/`` — the controller/replica/router/proxy
+architecture (``_private/controller.py:84``, ``replica.py``,
+``pow_2_scheduler.py:52``, ``proxy.py``) on ray_tpu actors.
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __init__(self, scale):
+            self.scale = scale
+        def __call__(self, x):
+            return self.scale * x
+
+    handle = serve.run(Model.bind(3))
+    assert ray_tpu.get(handle.remote(2), timeout=30) == 6
+
+TPU-first: a deployment's ``ray_actor_options={"resources": {"TPU": n}}``
+puts each replica on chips; ``max_concurrent_queries`` maps to actor
+``max_concurrency`` so batched inference saturates a replica's chip."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.controller import (
+    CONTROLLER_NAME,
+    get_or_create_controller,
+)
+from ray_tpu.serve.proxy import start_http, stop_http
+from ray_tpu.serve.router import Router
+
+
+class Application:
+    """A deployment bound to its init args (reference ``.bind()``)."""
+
+    def __init__(self, deployment: "Deployment", args, kwargs):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, cls_or_fn, name: str, config: DeploymentConfig):
+        self._cls_or_fn = cls_or_fn
+        self.name = name
+        self.config = config
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, **updates) -> "Deployment":
+        import dataclasses
+
+        cfg_fields = {f.name for f in dataclasses.fields(DeploymentConfig)}
+        cfg = dataclasses.replace(
+            self.config, **{k: v for k, v in updates.items() if k in cfg_fields}
+        )
+        name = updates.get("name", self.name)
+        return Deployment(self._cls_or_fn, name, cfg)
+
+
+def deployment(
+    _cls=None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_concurrent_queries: int = 8,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+    autoscaling_config: Optional[AutoscalingConfig] = None,
+    route_prefix: Optional[str] = None,
+):
+    """Class/function decorator → Deployment (reference ``@serve.deployment``)."""
+
+    def wrap(cls_or_fn):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            ray_actor_options=dict(ray_actor_options or {}),
+            autoscaling=autoscaling_config,
+            route_prefix=route_prefix,
+        )
+        return Deployment(cls_or_fn, name or cls_or_fn.__name__, cfg)
+
+    if _cls is not None:
+        return wrap(_cls)
+    return wrap
+
+
+class DeploymentHandle:
+    """Client-side handle: pow-2 routed calls returning ObjectRefs
+    (reference ``DeploymentHandle``/``Router``)."""
+
+    def __init__(self, deployment_name: str, controller=None):
+        self._name = deployment_name
+        self._controller = controller or get_or_create_controller()
+        self._router = Router(self._controller, deployment_name)
+
+    def remote(self, *args, **kwargs):
+        return self._router.dispatch("__call__", args, kwargs)
+
+    def method(self, method_name: str):
+        def call(*args, **kwargs):
+            return self._router.dispatch(method_name, args, kwargs)
+
+        return call
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name,))
+
+
+def run(app: Application, *, name: Optional[str] = None, _blocking_ready: bool = True) -> DeploymentHandle:
+    """Deploy an application; returns its handle (reference ``serve.run``)."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    dep = app.deployment
+    controller = get_or_create_controller()
+    ray_tpu.get(
+        controller.deploy.remote(
+            dep.name, dep._cls_or_fn, list(app.args), dict(app.kwargs), dep.config
+        ),
+        timeout=120,
+    )
+    handle = DeploymentHandle(dep.name, controller)
+    if _blocking_ready:
+        handle._router.choose_replica()  # wait for ≥1 replica
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def delete(name: str) -> None:
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def status() -> Dict[str, Dict[str, Any]]:
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+def shutdown() -> None:
+    stop_http()
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "start_http",
+    "status",
+    "stop_http",
+]

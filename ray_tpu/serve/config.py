@@ -1,0 +1,32 @@
+"""Serve configuration dataclasses.
+
+Reference: ``serve/config.py`` + ``serve/schema.py`` (DeploymentConfig,
+autoscaling config). TPU note: replicas may reserve ``{"TPU": n}`` so a
+deployment maps onto chips exactly like any other actor."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Queue-length autoscaling (reference ``autoscaling_state.py:262``,
+    ``serve/autoscaling_policy.py:100``): scale toward
+    total_ongoing / target_ongoing_requests replicas."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_concurrent_queries: int = 8
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    autoscaling: Optional[AutoscalingConfig] = None
+    route_prefix: Optional[str] = None

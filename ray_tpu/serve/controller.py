@@ -1,0 +1,214 @@
+"""ServeController: reconciles deployments to their target state.
+
+Reference: ``serve/_private/controller.py:84`` (deploy_application
+``:719``), ``deployment_state.py:2331`` (replica FSM reconcile) and
+``autoscaling_state.py:262`` (queue-length autoscaling). One named
+controller actor owns the replica sets; handles/proxies query it for
+routing tables and it runs a control loop: start missing replicas,
+reap dead ones, and scale on the replicas' reported ongoing-request
+counts."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.replica import Replica
+
+CONTROLLER_NAME = "__serve_controller__"
+
+
+class _DeploymentState:
+    def __init__(self, name, cls_or_fn, init_args, init_kwargs, config: DeploymentConfig):
+        self.name = name
+        self.cls_or_fn = cls_or_fn
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config = config
+        self.target = (
+            config.autoscaling.min_replicas if config.autoscaling else config.num_replicas
+        )
+        self.replicas: List[Any] = []
+        self.last_scale_ts = 0.0
+        self.ongoing_history: List[float] = []
+
+
+class _ServeController:
+    """Runs inside an actor; a background thread reconciles."""
+
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._lock = threading.Lock()
+        # serializes whole reconcile passes: deploy() (RPC thread) and the
+        # control loop both reconcile, and unsynchronized passes would
+        # double-start replicas then drop one set from tracking (leak)
+        self._reconcile_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._control_loop, daemon=True, name="serve-control"
+        )
+        self._thread.start()
+
+    # -- API -------------------------------------------------------------
+    def deploy(self, name, cls_or_fn, init_args, init_kwargs, config: DeploymentConfig) -> bool:
+        with self._lock:
+            old = self._deployments.get(name)
+            state = _DeploymentState(name, cls_or_fn, init_args, init_kwargs, config)
+            self._deployments[name] = state
+            if old is not None:
+                # rolling-update-lite: drop old replicas; reconcile starts new
+                for r in old.replicas:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+        self._reconcile_once()
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            state = self._deployments.pop(name, None)
+        if state is None:
+            return False
+        for r in state.replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        return True
+
+    def get_replicas(self, name: str) -> List[Any]:
+        with self._lock:
+            state = self._deployments.get(name)
+            return list(state.replicas) if state else []
+
+    def routes(self) -> Dict[str, str]:
+        """route_prefix -> deployment name (proxy routing table)."""
+        with self._lock:
+            out = {}
+            for name, st in self._deployments.items():
+                prefix = st.config.route_prefix or f"/{name}"
+                out[prefix] = name
+            return out
+
+    def status(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                name: {
+                    "target": st.target,
+                    "replicas": len(st.replicas),
+                    "autoscaling": st.config.autoscaling is not None,
+                }
+                for name, st in self._deployments.items()
+            }
+
+    def ping(self) -> bool:
+        return True
+
+    def shutdown(self) -> bool:
+        self._stop.set()
+        with self._lock:
+            deployments = list(self._deployments.values())
+            self._deployments.clear()
+        for st in deployments:
+            for r in st.replicas:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        return True
+
+    # -- control loop ----------------------------------------------------
+    def _control_loop(self) -> None:
+        while not self._stop.wait(0.25):
+            try:
+                self._reconcile_once()
+                self._autoscale_once()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                import logging
+
+                logging.getLogger(__name__).exception("serve control loop error")
+
+    def _reconcile_once(self) -> None:
+        with self._reconcile_lock:
+            with self._lock:
+                states = list(self._deployments.values())
+            for st in states:
+                # reap dead replicas
+                alive = []
+                for r in st.replicas:
+                    try:
+                        ray_tpu.get(r.stats.remote(), timeout=5)
+                        alive.append(r)
+                    except Exception:
+                        pass
+                st.replicas = alive
+                started: List[Any] = []
+                while len(st.replicas) + len(started) < st.target:
+                    opts = dict(st.config.ray_actor_options)
+                    opts.setdefault(
+                        "max_concurrency", st.config.max_concurrent_queries
+                    )
+                    started.append(
+                        Replica.options(**opts).remote(
+                            st.cls_or_fn, st.init_args, st.init_kwargs
+                        )
+                    )
+                with self._lock:
+                    if self._deployments.get(st.name) is st:
+                        st.replicas.extend(started)
+                        started = []
+                # state swapped mid-reconcile (redeploy/delete): kill the
+                # replicas we just started for the stale state
+                for r in started:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+                while len(st.replicas) > st.target:
+                    victim = st.replicas.pop()
+                    try:
+                        ray_tpu.kill(victim)
+                    except Exception:
+                        pass
+
+    def _autoscale_once(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            states = [s for s in self._deployments.values() if s.config.autoscaling]
+        for st in states:
+            cfg: AutoscalingConfig = st.config.autoscaling
+            total = 0.0
+            n = 0
+            for r in st.replicas:
+                try:
+                    total += ray_tpu.get(r.stats.remote(), timeout=5)["ongoing"]
+                    n += 1
+                except Exception:
+                    pass
+            if n == 0:
+                continue
+            desired = max(
+                cfg.min_replicas,
+                min(cfg.max_replicas, round(total / cfg.target_ongoing_requests)),
+            )
+            delay = (
+                cfg.upscale_delay_s if desired > st.target else cfg.downscale_delay_s
+            )
+            if desired != st.target and now - st.last_scale_ts >= delay:
+                st.target = desired
+                st.last_scale_ts = now
+
+
+ServeController = ray_tpu.remote(_ServeController)
+
+
+def get_or_create_controller():
+    # get_if_exists handles the named-actor creation race internally
+    # (actor.py) and real creation failures surface as themselves.
+    return ServeController.options(
+        name=CONTROLLER_NAME, num_cpus=0, max_concurrency=16, get_if_exists=True
+    ).remote()

@@ -1,0 +1,125 @@
+"""HTTP proxy: aiohttp server routing requests to deployments.
+
+Reference: ``serve/_private/proxy.py`` (uvicorn/starlette ASGI proxy +
+``proxy_router``). Here: one aiohttp app per node (started on demand by
+``serve.start_http``), routes ``{route_prefix}`` → deployment via the
+controller's routing table, JSON in/out."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.router import Router
+
+_proxy = None
+_lock = threading.Lock()
+
+
+class HttpProxy:
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
+        self._controller = controller
+        self._routers: Dict[str, Router] = {}
+        self.host = host
+        self.port = port
+        self._started = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread = threading.Thread(target=self._serve, daemon=True, name="serve-proxy")
+        self._thread.start()
+        if not self._started.wait(15):
+            raise RuntimeError("http proxy failed to start")
+
+    def _router_for(self, deployment: str) -> Router:
+        r = self._routers.get(deployment)
+        if r is None:
+            r = self._routers[deployment] = Router(self._controller, deployment)
+        return r
+
+    def _routes_cached(self) -> Dict[str, str]:
+        import time
+
+        now = time.monotonic()
+        if now - getattr(self, "_routes_ts", 0.0) > 1.0:
+            self._routes = ray_tpu.get(self._controller.routes.remote(), timeout=30)
+            self._routes_ts = now
+        return self._routes
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        loop = asyncio.get_event_loop()
+        # the controller RPC blocks — never run it on the proxy loop (one
+        # slow controller would freeze ALL in-flight HTTP traffic)
+        routes = await loop.run_in_executor(None, self._routes_cached)
+        path = request.path
+        target = None
+        for prefix, name in sorted(routes.items(), key=lambda kv: -len(kv[0])):
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                target = name
+                break
+        if target is None:
+            return web.json_response({"error": f"no route for {path}"}, status=404)
+        try:
+            body: Any = None
+            if request.can_read_body:
+                raw = await request.read()
+                if raw:
+                    try:
+                        body = json.loads(raw)
+                    except json.JSONDecodeError:
+                        body = raw.decode()
+            router = self._router_for(target)
+            loop = asyncio.get_event_loop()
+            ref = await loop.run_in_executor(
+                None, lambda: router.dispatch("__call__", (body,), {})
+            )
+            result = await loop.run_in_executor(
+                None, lambda: ray_tpu.get(ref, timeout=60)
+            )
+            if isinstance(result, Exception):
+                raise result
+            return web.json_response({"result": result})
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": repr(e)}, status=500)
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app)
+
+        async def _start():
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self._started.set()
+
+        loop.run_until_complete(_start())
+        loop.run_forever()
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+def start_http(controller, host: str = "127.0.0.1", port: int = 8000) -> HttpProxy:
+    global _proxy
+    with _lock:
+        if _proxy is None:
+            _proxy = HttpProxy(controller, host, port)
+        return _proxy
+
+
+def stop_http() -> None:
+    global _proxy
+    with _lock:
+        if _proxy is not None:
+            _proxy.stop()
+            _proxy = None

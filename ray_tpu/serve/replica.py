@@ -1,0 +1,66 @@
+"""Replica actor: hosts one copy of the user callable.
+
+Reference: ``serve/_private/replica.py`` — wraps the deployment's
+class (or function), counts ongoing requests (the router's pow-2 signal
+and the autoscaler's input), supports sync and async callables."""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any
+
+import ray_tpu
+
+
+class _Replica:
+    """Defined undecorated so cloudpickle exports by module reference
+    (see tune/trial.py for the rationale)."""
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs):
+        if inspect.isclass(cls_or_fn):
+            self._callable = cls_or_fn(*init_args, **(init_kwargs or {}))
+        else:
+            self._callable = cls_or_fn
+        self._ongoing = 0
+        self._total = 0
+
+    async def handle_request(self, method: str, args, kwargs) -> Any:
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if method == "__call__":
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method)
+            if inspect.iscoroutinefunction(fn) or (
+                not inspect.isfunction(fn)
+                and not inspect.ismethod(fn)
+                and inspect.iscoroutinefunction(getattr(fn, "__call__", None))
+            ):
+                return await fn(*args, **(kwargs or {}))
+            # Sync callables run on a worker thread: executing them inline
+            # would block this actor's single async loop and serialize all
+            # max_concurrent_queries requests (and starve stats()).
+            loop = asyncio.get_event_loop()
+            result = await loop.run_in_executor(
+                None, lambda: fn(*args, **(kwargs or {}))
+            )
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._ongoing -= 1
+
+    def stats(self):
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def health(self) -> bool:
+        check = getattr(self._callable, "check_health", None)
+        if check is None:
+            return True
+        result = check()
+        return bool(result) if not inspect.iscoroutine(result) else True
+
+
+Replica = ray_tpu.remote(_Replica)

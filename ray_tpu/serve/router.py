@@ -1,0 +1,78 @@
+"""Router: pow-2 replica choice.
+
+Reference: ``serve/_private/replica_scheduler/pow_2_scheduler.py:52`` —
+sample two replicas, compare their queue lengths, send to the shorter.
+The replica list refreshes from the controller periodically (long-poll
+equivalent of the reference's LongPollClient config push)."""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+_REFRESH_S = 1.0
+_STATS_TTL_S = 0.25
+
+
+class Router:
+    def __init__(self, controller, deployment: str):
+        self._controller = controller
+        self._deployment = deployment
+        self._replicas: List[Any] = []
+        self._last_refresh = 0.0
+        # replica -> (fetched_at, ongoing + local optimistic bumps):
+        # fresh stats RPCs per dispatch would double request latency and
+        # add 2x load (the reference compares CACHED queue lengths)
+        self._stats: dict = {}
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_refresh < _REFRESH_S and self._replicas:
+            return
+        self._replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self._deployment), timeout=30
+        )
+        self._last_refresh = now
+
+    def choose_replica(self):
+        self._refresh()
+        deadline = time.monotonic() + 30
+        while not self._replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for deployment {self._deployment!r}"
+                )
+            time.sleep(0.1)
+            self._refresh(force=True)
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = random.sample(self._replicas, 2)
+        qa, qb = self._queue_len(a), self._queue_len(b)
+        return a if qa <= qb else b
+
+    def _queue_len(self, replica) -> float:
+        now = time.monotonic()
+        entry = self._stats.get(replica)
+        if entry is not None and now - entry[0] < _STATS_TTL_S:
+            return entry[1]
+        try:
+            ongoing = float(
+                ray_tpu.get(replica.stats.remote(), timeout=10)["ongoing"]
+            )
+        except Exception:
+            self._refresh(force=True)
+            ongoing = 0.0
+        self._stats[replica] = (now, ongoing)
+        return ongoing
+
+    def dispatch(self, method: str, args, kwargs):
+        replica = self.choose_replica()
+        # optimistic local bump so a burst within the TTL window spreads
+        # instead of dogpiling the momentarily-shortest queue
+        entry = self._stats.get(replica)
+        if entry is not None:
+            self._stats[replica] = (entry[0], entry[1] + 1.0)
+        return replica.handle_request.remote(method, list(args), dict(kwargs or {}))
