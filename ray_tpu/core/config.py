@@ -62,6 +62,16 @@ class GlobalConfig:
     #: specs per push RPC on a held lease (serial worker-side execution)
     lease_push_batch: int = 8
 
+    # --- observability ---
+    #: serve a Prometheus /metrics endpoint from daemons + controller
+    metrics_export_enabled: bool = True
+    #: fixed metrics port (0 = auto-assign per process)
+    metrics_port: int = 0
+    #: tail worker logs and forward them to connected drivers
+    log_to_driver: bool = True
+    #: push task lifecycle events to the controller (state API `list tasks`)
+    task_events_enabled: bool = True
+
     # --- RPC ---
     rpc_connect_timeout_s: float = 10.0
     rpc_retry_base_delay_s: float = 0.05

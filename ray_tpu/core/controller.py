@@ -41,6 +41,7 @@ logger = logging.getLogger(__name__)
 ACTOR_PUSH_CHANNEL = 1
 NODE_PUSH_CHANNEL = 2
 PG_PUSH_CHANNEL = 3
+LOG_PUSH_CHANNEL = 4
 
 
 @dataclass
@@ -92,7 +93,11 @@ class Controller:
         self.removed_pgs: "OrderedDict[bytes, None]" = OrderedDict()
         self.kv: Dict[bytes, bytes] = {}
         self.jobs: Dict[bytes, Dict[str, Any]] = {}
+        # task-event ring buffer (``GcsTaskManager`` — serves the state
+        # API's `list tasks`; workers push batched lifecycle events)
+        self.task_events: "OrderedDict[bytes, Dict[str, Any]]" = OrderedDict()
         self._subscribers: Set[ServerConnection] = set()
+        self._metrics_server = None
         self._health_task: Optional[asyncio.Task] = None
         self._stopping = False
         for name in [m for m in dir(self) if m.startswith("c_")]:
@@ -102,10 +107,51 @@ class Controller:
     async def start(self) -> int:
         port = await self.server.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
+        self._start_metrics()
         return port
+
+    def _start_metrics(self) -> None:
+        if not GLOBAL_CONFIG.metrics_export_enabled:
+            return
+        from ray_tpu.observability.metrics import Gauge, MetricsServer, on_collect
+
+        g_nodes = Gauge("raytpu_nodes", "cluster nodes", ("state",))
+        g_actors = Gauge("raytpu_actors", "actors by state", ("state",))
+        g_pgs = Gauge("raytpu_placement_groups", "placement groups by state", ("state",))
+
+        def sample() -> None:
+            alive = sum(1 for n in self.nodes.values() if n.alive)
+            g_nodes.set(alive, {"state": "alive"})
+            g_nodes.set(len(self.nodes) - alive, {"state": "dead"})
+            by_state: Dict[str, int] = {}
+            for info in self.actors.values():
+                by_state[info.state] = by_state.get(info.state, 0) + 1
+            for state in ("PENDING", "ALIVE", "RESTARTING", "DEAD"):
+                g_actors.set(by_state.get(state, 0), {"state": state})
+            pg_states: Dict[str, int] = {}
+            for info in self.pgs.values():
+                pg_states[info.state] = pg_states.get(info.state, 0) + 1
+            for state in ("PENDING", "CREATED"):
+                g_pgs.set(pg_states.get(state, 0), {"state": state})
+
+        self._metrics_cb = on_collect(sample)
+        self._metrics_server = MetricsServer(port=GLOBAL_CONFIG.metrics_port)
+        logger.info(
+            "controller metrics at http://127.0.0.1:%d/metrics",
+            self._metrics_server.port,
+        )
+
+    @property
+    def metrics_port(self) -> int:
+        return self._metrics_server.port if self._metrics_server else 0
 
     async def stop(self) -> None:
         self._stopping = True
+        if self._metrics_server is not None:
+            from ray_tpu.observability.metrics import remove_collect
+
+            remove_collect(self._metrics_cb)
+            self._metrics_server.stop()
         if self._health_task:
             self._health_task.cancel()
         for c in self.node_clients.values():
@@ -575,6 +621,78 @@ class Controller:
             }
             for pg_id, info in self.pgs.items()
         }
+
+    # ---- observability --------------------------------------------------
+    async def c_worker_logs(self, payload, conn):
+        """Daemon-forwarded worker log lines → broadcast to drivers
+        (reference LogMonitor → GCS pubsub → driver)."""
+        await self._publish(
+            LOG_PUSH_CHANNEL,
+            {"node_id": payload["node_id"], "batch": payload["batch"]},
+        )
+        return True
+
+    async def c_task_events(self, payload, conn):
+        """Batched task lifecycle events (``GcsTaskManager`` sink).
+
+        Each event: {task_id, name, state, worker?, ts}; the latest state
+        per task wins; the table is a bounded ring."""
+        rank = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+        for ev in payload["events"]:
+            tid = ev["task_id"]
+            cur = self.task_events.get(tid)
+            if cur is None:
+                self.task_events[tid] = ev
+            else:
+                # never downgrade: a worker's late-flushed RUNNING must
+                # not overwrite the driver's FINISHED (batch windows race)
+                if rank.get(ev["state"], 0) >= rank.get(cur["state"], 0):
+                    cur.update(ev)
+                self.task_events.move_to_end(tid)
+        while len(self.task_events) > 10000:
+            self.task_events.popitem(last=False)
+        return True
+
+    async def c_list_tasks(self, payload, conn):
+        limit = payload.get("limit", 1000)
+        out = []
+        for ev in list(self.task_events.values())[-limit:]:
+            out.append(dict(ev, task_id=ev["task_id"].hex()))
+        return out
+
+    async def c_list_actors(self, payload, conn):
+        return [
+            {
+                "actor_id": actor_id.hex(),
+                "name": info.spec.name,
+                "class_name": info.spec.method_name or info.spec.name,
+                "state": info.state,
+                "pid": info.pid,
+                "node_id": info.node_id.hex() if info.node_id else None,
+                "num_restarts": info.num_restarts,
+            }
+            for actor_id, info in self.actors.items()
+        ]
+
+    async def c_list_objects(self, payload, conn):
+        """Cluster-wide shm object listing, aggregated from daemons
+        (concurrent fan-out: N sequential 10s timeouts would stall the
+        control loop on dead nodes)."""
+        items = list(self.node_clients.items())
+
+        async def one(client):
+            try:
+                return await client.call("list_objects", {}, timeout=10)
+            except Exception:
+                return []
+
+        results = await asyncio.gather(*[one(c) for _nid, c in items])
+        out = []
+        for (node_id, _c), objs in zip(items, results):
+            for o in objs:
+                o["node_id"] = node_id.hex()
+                out.append(o)
+        return out
 
     # ---- kv ------------------------------------------------------------
     async def c_kv_put(self, payload, conn):

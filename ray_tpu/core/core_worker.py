@@ -28,7 +28,12 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ray_tpu.core import serialization
 from ray_tpu.core.api import RuntimeBackend
 from ray_tpu.core.config import GLOBAL_CONFIG
-from ray_tpu.core.controller import ACTOR_PUSH_CHANNEL, NODE_PUSH_CHANNEL, PG_PUSH_CHANNEL
+from ray_tpu.core.controller import (
+    ACTOR_PUSH_CHANNEL,
+    LOG_PUSH_CHANNEL,
+    NODE_PUSH_CHANNEL,
+    PG_PUSH_CHANNEL,
+)
 from ray_tpu.core.exceptions import (
     ActorDiedError,
     GetTimeoutError,
@@ -111,6 +116,10 @@ class CoreWorker(RuntimeBackend):
         # lease-reuse submission (per scheduling class)
         self._class_queues: Dict[Any, "_ClassQueue"] = {}
         self._retries_left: Dict[bytes, int] = {}
+        # task-event buffer (``core_worker/task_event_buffer`` →
+        # ``GcsTaskManager``): batched lifecycle events for `list tasks`
+        self._task_events: List[Dict[str, Any]] = []
+        self._task_events_flushing = False
 
         async def _setup():
             self.server = RpcServer()
@@ -121,6 +130,10 @@ class CoreWorker(RuntimeBackend):
             self.daemon = RpcClient(daemon_host, daemon_port, name="noded")
             self.controller.subscribe_push(ACTOR_PUSH_CHANNEL, self._on_actor_push)
             self.controller.subscribe_push(PG_PUSH_CHANNEL, self._on_pg_push)
+            if executor is None and GLOBAL_CONFIG.log_to_driver:
+                # drivers print forwarded worker logs (reference
+                # LogMonitor → pubsub → driver stdout)
+                self.controller.subscribe_push(LOG_PUSH_CHANNEL, self._on_log_push)
             await self.controller.call("subscribe", retries=GLOBAL_CONFIG.rpc_max_retries)
             return port
 
@@ -455,6 +468,7 @@ class CoreWorker(RuntimeBackend):
         for oid in spec.return_ids:
             self.refcounter.create_pending(oid, lineage=spec, hold=True)
         self._pin_deps(spec)
+        self.emit_task_event(spec, "SUBMITTED")
         self.io.post(self._enqueue_normal(spec))
 
     def _try_recover(self, oid: ObjectID, observed_locations=None) -> bool:
@@ -686,6 +700,42 @@ class CoreWorker(RuntimeBackend):
         self._cancelled_tasks.pop(tid, None)
         self._retries_left.pop(tid, None)
         self._unpin_deps(spec)
+        self.emit_task_event(spec, "FAILED" if error is not None else "FINISHED")
+
+    # ------------------------------------------------------------------
+    # task events (batched → controller; reference task_event_buffer)
+    def emit_task_event(self, spec: TaskSpec, state: str) -> None:
+        if not GLOBAL_CONFIG.task_events_enabled:
+            return
+        self._task_events.append(
+            {
+                "task_id": spec.task_id.binary(),
+                "name": spec.name,
+                "state": state,
+                "ts": time.time(),
+            }
+        )
+        if not self._task_events_flushing:
+            self._task_events_flushing = True
+            self.io.post(self._flush_task_events())
+
+    async def _flush_task_events(self) -> None:
+        try:
+            await asyncio.sleep(0.2)  # batch window
+            events, self._task_events = self._task_events, []
+            if events:
+                await self.controller.call(
+                    "task_events", {"events": events}, timeout=10
+                )
+        except Exception:
+            pass  # observability is best-effort
+        finally:
+            # events that arrived while the RPC was in flight must not
+            # strand in the buffer until the next emit — reschedule
+            if self._task_events and not self._stopping:
+                self.io.post(self._flush_task_events())
+            else:
+                self._task_events_flushing = False
 
     async def _acquire_lease(self, spec: TaskSpec) -> Dict[str, Any]:
         """Lease with spillback-following (reference lease protocol).
@@ -828,6 +878,15 @@ class CoreWorker(RuntimeBackend):
             if msg["state"] == "DEAD":
                 st.creation_spec = None  # release pinned creation args
             st.event.set()
+
+    def _on_log_push(self, msg: Dict[str, Any]) -> None:
+        import sys
+
+        node = msg["node_id"].hex()[:8]
+        for entry in msg.get("batch", []):
+            worker = entry["worker"].replace("worker-", "").replace(".log", "")
+            for line in entry["lines"]:
+                print(f"({worker}, node={node}) {line}", file=sys.stderr)
 
     def _on_pg_push(self, msg: Dict[str, Any]) -> None:
         # Only track PGs this process has expressed interest in (created or

@@ -144,6 +144,7 @@ class NodeDaemon:
     async def start(self) -> int:
         port = await self.server.start()
         self.port = port
+        self._start_metrics()
         await self.controller.call(
             "register_node",
             {
@@ -157,10 +158,49 @@ class NodeDaemon:
         )
         self._tasks.append(asyncio.ensure_future(self._sync_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        self._tasks.append(asyncio.ensure_future(self._log_tail_loop()))
         return port
+
+    def _start_metrics(self) -> None:
+        """Prometheus /metrics endpoint (reference ``metrics_agent.py`` →
+        ``prometheus_exporter.py``; system metrics per ``metric_defs.cc``)."""
+        if not GLOBAL_CONFIG.metrics_export_enabled:
+            self.metrics_port = 0
+            return
+        from ray_tpu.observability.metrics import Gauge, MetricsServer, on_collect
+
+        nid = self.node_id.hex()[:12]
+        g_store_used = Gauge("raytpu_object_store_used_bytes", "shm store bytes in use", ("node",))
+        g_store_objs = Gauge("raytpu_object_store_num_objects", "objects in the shm store", ("node",))
+        g_spilled = Gauge("raytpu_object_store_num_spilled", "objects spilled to disk", ("node",))
+        g_workers = Gauge("raytpu_workers", "worker processes", ("node", "state"))
+        g_leases = Gauge("raytpu_active_leases", "granted worker leases", ("node",))
+        g_avail = Gauge("raytpu_resource_available", "available resource capacity", ("node", "resource"))
+
+        def sample() -> None:
+            st = self.store.stats()
+            labels = {"node": nid}
+            g_store_used.set(st["used_bytes"], labels)
+            g_store_objs.set(st["num_objects"], labels)
+            g_spilled.set(st["num_spilled"], labels)
+            g_workers.set(len(self.workers), {"node": nid, "state": "total"})
+            g_workers.set(len(self.idle), {"node": nid, "state": "idle"})
+            g_leases.set(len(self.leases), labels)
+            for res, val in self.resources.available.to_dict().items():
+                g_avail.set(val, {"node": nid, "resource": res})
+
+        self._metrics_cb = on_collect(sample)
+        self._metrics_server = MetricsServer(port=GLOBAL_CONFIG.metrics_port)
+        self.metrics_port = self._metrics_server.port
+        logger.info("metrics at http://127.0.0.1:%d/metrics", self.metrics_port)
 
     async def stop(self) -> None:
         self._stopping = True
+        if getattr(self, "_metrics_server", None) is not None:
+            from ray_tpu.observability.metrics import remove_collect
+
+            remove_collect(self._metrics_cb)
+            self._metrics_server.stop()
         for t in self._tasks:
             t.cancel()
         for w in self.workers.values():
@@ -181,6 +221,60 @@ class NodeDaemon:
             await c.close()
         self.store.shutdown()
         await self.server.stop()
+
+    async def _log_tail_loop(self) -> None:
+        """Tail this node's worker log files and forward new lines to the
+        controller for driver display (reference ``LogMonitor``,
+        ``_private/log_monitor.py:103``)."""
+        if not GLOBAL_CONFIG.log_to_driver:
+            return
+        import glob as _glob
+
+        offsets: Dict[str, int] = {}
+        logs_dir = os.path.join(self.session_dir, "logs")
+        while not self._stopping:
+            await asyncio.sleep(0.5)
+            batch = []
+            try:
+                for path in _glob.glob(os.path.join(logs_dir, "worker-*.log")):
+                    try:
+                        size = os.path.getsize(path)
+                        off = offsets.get(path, 0)
+                        if size < off:
+                            off = 0  # truncated/rotated: restart from top
+                        if size == off:
+                            offsets[path] = off
+                            continue
+                        with open(path, "rb") as f:
+                            f.seek(off)
+                            data = f.read(min(size - off, 1 << 16))
+                        # advance only past COMPLETE lines — a partial
+                        # tail line is re-read next tick, and nothing is
+                        # ever skipped (the chunk bound paces big bursts
+                        # across ticks instead of dropping them)
+                        cut = data.rfind(b"\n")
+                        if cut < 0:
+                            offsets[path] = off
+                            continue
+                        offsets[path] = off + cut + 1
+                        lines = data[: cut + 1].decode(errors="replace").splitlines()
+                        if lines:
+                            batch.append(
+                                {
+                                    "worker": os.path.basename(path),
+                                    "lines": lines,
+                                }
+                            )
+                    except OSError:
+                        continue
+                if batch:
+                    await self.controller.call(
+                        "worker_logs",
+                        {"node_id": self.node_id.binary(), "batch": batch},
+                        timeout=10,
+                    )
+            except Exception:
+                pass  # forwarding is best-effort
 
     # ---- resource sync (ray_syncer) -----------------------------------
     async def _sync_loop(self) -> None:
@@ -695,6 +789,9 @@ class NodeDaemon:
         """Driver handshake: learn the local node id."""
         return {"node_id": self.node_id.binary()}
 
+    async def d_list_objects(self, payload, conn):
+        return self.store.list_entries()
+
     async def d_stats(self, payload, conn):
         return {
             "node_id": self.node_id.binary(),
@@ -703,4 +800,5 @@ class NodeDaemon:
             "num_idle": len(self.idle),
             "num_leases": len(self.leases),
             "resources": self.resources.to_dict(),
+            "metrics_port": getattr(self, "metrics_port", 0),
         }

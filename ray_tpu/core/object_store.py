@@ -104,6 +104,20 @@ class ShmStore:
             e = self._entries.get(object_id)
             return e is not None
 
+    def list_entries(self) -> List[Dict[str, object]]:
+        """State-API view of every tracked object (``ray list objects``)."""
+        with self._lock:
+            return [
+                {
+                    "object_id": oid.hex(),
+                    "size": e.size,
+                    "in_shm": e.in_shm,
+                    "pinned": e.pinned,
+                    "spilled": e.spilled_path is not None,
+                }
+                for oid, e in self._entries.items()
+            ]
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {

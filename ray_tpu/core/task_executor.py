@@ -280,10 +280,12 @@ class TaskExecutor:
             if len(self._cancelled) > 4096:
                 self._cancelled.clear()  # stale marks on a long-lived worker
             if spec.kind != TaskKind.ACTOR_TASK:
-                # only normal tasks are async-exc cancellable: they run on
-                # dedicated throwaway threads (actor tasks share pooled
-                # lane threads where a stray exception would poison peers)
+                # only normal tasks are async-exc cancellable (actor tasks
+                # share pooled lane threads where a stray exception would
+                # poison peers)
                 self._running_threads[tid] = threading.get_ident()
+        if spec.kind != TaskKind.ACTOR_TASK:
+            self.core.emit_task_event(spec, "RUNNING")
         try:
             try:
                 if spec.kind == TaskKind.ACTOR_TASK:

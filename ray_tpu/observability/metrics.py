@@ -1,0 +1,155 @@
+"""Metrics: counters/gauges + Prometheus text exposition.
+
+Reference: ``src/ray/stats/metric_defs.cc`` (system metric definitions),
+``_private/metrics_agent.py`` + ``_private/prometheus_exporter.py`` (the
+per-node agent exposing Prometheus text). Here each daemon/controller
+process runs a tiny stdlib HTTP endpoint serving ``/metrics`` in the
+Prometheus exposition format; user code gets the same Counter/Gauge
+API as ``ray.util.metrics``."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+_REGISTRY_LOCK = threading.Lock()
+_METRICS: Dict[str, "Metric"] = {}
+_COLLECT_CALLBACKS: List[Callable[[], None]] = []
+
+
+class Metric:
+    """Base: name + help + labelled values."""
+
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "", labelnames: Tuple[str, ...] = ()):
+        self.name = name
+        self.description = description
+        self.labelnames = tuple(labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+        with _REGISTRY_LOCK:
+            existing = _METRICS.get(name)
+            if existing is not None:
+                # re-registration returns the same underlying metric
+                self._values = existing._values
+                self._lock = existing._lock
+            else:
+                _METRICS[name] = self
+
+    def _key(self, labels: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        labels = labels or {}
+        return tuple(str(labels.get(k, "")) for k in self.labelnames)
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def collect(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.description}",
+            f"# TYPE {self.name} {self.TYPE}",
+        ]
+        with self._lock:
+            for key, value in sorted(self._values.items()):
+                if self.labelnames:
+                    label_str = ",".join(
+                        f'{n}="{v}"' for n, v in zip(self.labelnames, key)
+                    )
+                    lines.append(f"{self.name}{{{label_str}}} {value}")
+                else:
+                    lines.append(f"{self.name} {value}")
+        return lines
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, amount: float = 1.0, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+
+def on_collect(cb: Callable[[], None]) -> Callable[[], None]:
+    """Register a callback run right before exposition (for gauges
+    sampled from live state, e.g. store bytes). Returns ``cb`` so the
+    owner can deregister it at shutdown."""
+    with _REGISTRY_LOCK:
+        _COLLECT_CALLBACKS.append(cb)
+    return cb
+
+
+def remove_collect(cb: Callable[[], None]) -> None:
+    with _REGISTRY_LOCK:
+        try:
+            _COLLECT_CALLBACKS.remove(cb)
+        except ValueError:
+            pass
+
+
+def render() -> str:
+    with _REGISTRY_LOCK:
+        callbacks = list(_COLLECT_CALLBACKS)
+        metrics = list(_METRICS.values())
+    for cb in callbacks:
+        try:
+            cb()
+        except Exception:
+            pass
+    out: List[str] = []
+    for m in metrics:
+        out.extend(m.collect())
+    return "\n".join(out) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        if self.path.rstrip("/") not in ("", "/metrics", "/healthz"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        if self.path.rstrip("/") == "/healthz":
+            body = b"ok"
+            ctype = "text/plain"
+        else:
+            body = render().encode()
+            ctype = "text/plain; version=0.0.4"
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+
+class MetricsServer:
+    """Prometheus exposition endpoint for this process."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        try:
+            self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        except OSError:
+            # fixed port already taken (e.g. controller + daemon
+            # co-hosted): fall back to auto-assign rather than failing
+            # cluster startup
+            self._httpd = ThreadingHTTPServer((host, 0), _Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="metrics"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass

@@ -137,14 +137,23 @@ class _ServeController:
             with self._lock:
                 states = list(self._deployments.values())
             for st in states:
-                # reap dead replicas
+                # reap dead replicas. A stats TIMEOUT is overload, not
+                # death — keep the replica (dropping it would churn
+                # healthy-but-slow replicas); real death (actor error /
+                # connection loss) drops it, with a defensive kill so a
+                # half-dead replica can't leak its reservation.
                 alive = []
                 for r in st.replicas:
                     try:
                         ray_tpu.get(r.stats.remote(), timeout=5)
                         alive.append(r)
+                    except ray_tpu.GetTimeoutError:
+                        alive.append(r)  # slow ≠ dead
                     except Exception:
-                        pass
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:
+                            pass
                 st.replicas = alive
                 started: List[Any] = []
                 while len(st.replicas) + len(started) < st.target:

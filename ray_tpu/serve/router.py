@@ -3,7 +3,11 @@
 Reference: ``serve/_private/replica_scheduler/pow_2_scheduler.py:52`` —
 sample two replicas, compare their queue lengths, send to the shorter.
 The replica list refreshes from the controller periodically (long-poll
-equivalent of the reference's LongPollClient config push)."""
+equivalent of the reference's LongPollClient config push).
+
+Routing is at-most-once: a dispatch racing a replica death surfaces
+ActorDiedError on the returned ref (callers retry); the next refresh
+drops the dead replica from the candidate set."""
 
 from __future__ import annotations
 
@@ -36,6 +40,10 @@ class Router:
             self._controller.get_replicas.remote(self._deployment), timeout=30
         )
         self._last_refresh = now
+        # prune stats for replicas that no longer exist (cache is keyed by
+        # actor id — handle objects change identity every refresh)
+        live = {r.actor_id for r in self._replicas}
+        self._stats = {k: v for k, v in self._stats.items() if k in live}
 
     def choose_replica(self):
         self._refresh()
@@ -55,7 +63,8 @@ class Router:
 
     def _queue_len(self, replica) -> float:
         now = time.monotonic()
-        entry = self._stats.get(replica)
+        key = replica.actor_id
+        entry = self._stats.get(key)
         if entry is not None and now - entry[0] < _STATS_TTL_S:
             return entry[1]
         try:
@@ -65,14 +74,15 @@ class Router:
         except Exception:
             self._refresh(force=True)
             ongoing = 0.0
-        self._stats[replica] = (now, ongoing)
+        self._stats[key] = (now, ongoing)
         return ongoing
 
     def dispatch(self, method: str, args, kwargs):
         replica = self.choose_replica()
         # optimistic local bump so a burst within the TTL window spreads
         # instead of dogpiling the momentarily-shortest queue
-        entry = self._stats.get(replica)
+        key = replica.actor_id
+        entry = self._stats.get(key)
         if entry is not None:
-            self._stats[replica] = (entry[0], entry[1] + 1.0)
+            self._stats[key] = (entry[0], entry[1] + 1.0)
         return replica.handle_request.remote(method, list(args), dict(kwargs or {}))

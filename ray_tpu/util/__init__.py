@@ -12,6 +12,7 @@ from ray_tpu.util.scheduling_strategies import (
     NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from ray_tpu.util import state
 
 __all__ = [
     "PlacementGroup",
@@ -22,4 +23,5 @@ __all__ = [
     "PlacementGroupSchedulingStrategy",
     "NodeAffinitySchedulingStrategy",
     "NodeLabelSchedulingStrategy",
+    "state",
 ]

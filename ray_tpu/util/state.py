@@ -1,0 +1,47 @@
+"""State API: cluster introspection (reference ``ray list ...``).
+
+Reference: ``python/ray/util/state/api.py:110,781`` — `list
+tasks/actors/objects/nodes/placement_groups` served from the control
+plane (``GcsTaskManager`` task events + controller tables + daemon
+object stores)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu.core.api import _global_worker
+
+
+def _call(method: str, payload: Dict[str, Any] = None):
+    core = _global_worker().backend
+    return core.io.run(core.controller.call(method, payload or {}))
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _call("nodes")
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    return _call("list_actors")
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Recent task lifecycle states (bounded ring; latest state wins)."""
+    return _call("list_tasks", {"limit": limit})
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Cluster-wide shm objects, aggregated across node daemons."""
+    return _call("list_objects")
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    table = _call("pg_table")
+    return [dict(info, pg_id=pg_id) for pg_id, info in table.items()]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for t in list_tasks():
+        out[t["state"]] = out.get(t["state"], 0) + 1
+    return out

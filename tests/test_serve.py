@@ -80,8 +80,17 @@ def test_replica_failure_recovery(cluster):
         if serve.status()["Flaky"]["replicas"] == 2:
             break
         time.sleep(0.5)
-    # reconcile loop replaced the dead replica; traffic still flows
-    assert ray_tpu.get(handle.remote(7), timeout=60) == 7
+    # reconcile loop replaced the dead replica; traffic still flows.
+    # Routing is at-most-once: a dispatch racing the replica death can
+    # land on the dead actor, so allow a couple of retries.
+    result = None
+    for _ in range(3):
+        try:
+            result = ray_tpu.get(handle.remote(7), timeout=60)
+            break
+        except ray_tpu.RayTpuError:
+            time.sleep(1.0)
+    assert result == 7
     assert serve.status()["Flaky"]["replicas"] == 2
     serve.delete("Flaky")
 
@@ -107,15 +116,18 @@ def test_autoscaling_up_and_down(cluster):
 
     handle = serve.run(Slow.bind())
     assert serve.status()["Slow"]["replicas"] == 1
-    # sustained burst: keep ~8 in flight for a few seconds
+    # sustained burst: keep requests in flight until the controller reacts
+    # (generous window — CI shares one vCPU across the whole cluster)
     refs = []
-    deadline = time.time() + 6
+    deadline = time.time() + 20
+    scaled = False
     while time.time() < deadline:
         refs.extend(handle.remote(i) for i in range(4))
         time.sleep(0.4)
         if serve.status()["Slow"]["replicas"] >= 2:
+            scaled = True
             break
-    assert serve.status()["Slow"]["replicas"] >= 2, "should scale up under load"
+    assert scaled, "should scale up under load"
     ray_tpu.get(refs, timeout=120)
     # idle: scales back toward min
     deadline = time.time() + 30
