@@ -67,6 +67,8 @@ class GlobalConfig:
     metrics_export_enabled: bool = True
     #: fixed metrics port (0 = auto-assign per process)
     metrics_port: int = 0
+    #: bind address for /metrics ("0.0.0.0" for off-host Prometheus)
+    metrics_bind_host: str = "127.0.0.1"
     #: tail worker logs and forward them to connected drivers
     log_to_driver: bool = True
     #: push task lifecycle events to the controller (state API `list tasks`)

@@ -97,6 +97,10 @@ class Controller:
         # API's `list tasks`; workers push batched lifecycle events)
         self.task_events: "OrderedDict[bytes, Dict[str, Any]]" = OrderedDict()
         self._subscribers: Set[ServerConnection] = set()
+        # channel → connections that asked for it (None entry = legacy
+        # subscribe-to-everything); high-volume channels (logs) only go
+        # where requested
+        self._channel_subs: Dict[int, Set[ServerConnection]] = {}
         self._metrics_server = None
         self._health_task: Optional[asyncio.Task] = None
         self._stopping = False
@@ -135,7 +139,7 @@ class Controller:
                 g_pgs.set(pg_states.get(state, 0), {"state": state})
 
         self._metrics_cb = on_collect(sample)
-        self._metrics_server = MetricsServer(port=GLOBAL_CONFIG.metrics_port)
+        self._metrics_server = MetricsServer(host=GLOBAL_CONFIG.metrics_bind_host, port=GLOBAL_CONFIG.metrics_port)
         logger.info(
             "controller metrics at http://127.0.0.1:%d/metrics",
             self._metrics_server.port,
@@ -160,21 +164,38 @@ class Controller:
 
     def _on_disconnect(self, conn: ServerConnection) -> None:
         self._subscribers.discard(conn)
+        for subs in self._channel_subs.values():
+            subs.discard(conn)
 
     # ---- pubsub --------------------------------------------------------
     async def _publish(self, channel: int, payload: Any) -> None:
-        dead = []
-        for conn in list(self._subscribers):
+        # legacy all-channel subscribers ∪ explicit channel subscribers
+        conns = list(self._subscribers | self._channel_subs.get(channel, set()))
+
+        async def push_one(c: ServerConnection):
             try:
-                await conn.push(channel, payload)
+                await c.push(channel, payload)
+                return None
             except Exception:
-                dead.append(conn)
+                return c
+
+        # concurrent: one slow connection must not stall every other
+        # subscriber's push (nor the caller)
+        dead = [c for c in await asyncio.gather(*[push_one(c) for c in conns]) if c]
         for conn in dead:
             self._subscribers.discard(conn)
+            for subs in self._channel_subs.values():
+                subs.discard(conn)
 
     async def c_subscribe(self, payload, conn: ServerConnection):
-        """Subscribe this connection to actor/node/pg pushes."""
-        self._subscribers.add(conn)
+        """Subscribe this connection to pushes. ``channels``: explicit
+        channel list; omitted = all broadcast channels (legacy)."""
+        channels = (payload or {}).get("channels")
+        if channels is None:
+            self._subscribers.add(conn)
+        else:
+            for ch in channels:
+                self._channel_subs.setdefault(ch, set()).add(conn)
         return True
 
     # ---- nodes & resource sync ----------------------------------------
@@ -655,6 +676,8 @@ class Controller:
 
     async def c_list_tasks(self, payload, conn):
         limit = payload.get("limit", 1000)
+        if limit <= 0:
+            return []
         out = []
         for ev in list(self.task_events.values())[-limit:]:
             out.append(dict(ev, task_id=ev["task_id"].hex()))

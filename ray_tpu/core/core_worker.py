@@ -117,8 +117,12 @@ class CoreWorker(RuntimeBackend):
         self._class_queues: Dict[Any, "_ClassQueue"] = {}
         self._retries_left: Dict[bytes, int] = {}
         # task-event buffer (``core_worker/task_event_buffer`` →
-        # ``GcsTaskManager``): batched lifecycle events for `list tasks`
+        # ``GcsTaskManager``): batched lifecycle events for `list tasks`.
+        # Locked: emitters run on lane/user threads, the flusher swaps the
+        # list on the io loop — an unguarded append could land on an
+        # already-sent list and silently vanish.
         self._task_events: List[Dict[str, Any]] = []
+        self._task_events_lock = threading.Lock()
         self._task_events_flushing = False
 
         async def _setup():
@@ -130,11 +134,19 @@ class CoreWorker(RuntimeBackend):
             self.daemon = RpcClient(daemon_host, daemon_port, name="noded")
             self.controller.subscribe_push(ACTOR_PUSH_CHANNEL, self._on_actor_push)
             self.controller.subscribe_push(PG_PUSH_CHANNEL, self._on_pg_push)
+            channels = [ACTOR_PUSH_CHANNEL, PG_PUSH_CHANNEL]
             if executor is None and GLOBAL_CONFIG.log_to_driver:
                 # drivers print forwarded worker logs (reference
-                # LogMonitor → pubsub → driver stdout)
+                # LogMonitor → pubsub → driver stdout); workers never
+                # subscribe the log channel, so the controller doesn't
+                # waste pushes on processes that would drop them
                 self.controller.subscribe_push(LOG_PUSH_CHANNEL, self._on_log_push)
-            await self.controller.call("subscribe", retries=GLOBAL_CONFIG.rpc_max_retries)
+                channels.append(LOG_PUSH_CHANNEL)
+            await self.controller.call(
+                "subscribe",
+                {"channels": channels},
+                retries=GLOBAL_CONFIG.rpc_max_retries,
+            )
             return port
 
         self.port = self.io.run(_setup())
@@ -707,22 +719,25 @@ class CoreWorker(RuntimeBackend):
     def emit_task_event(self, spec: TaskSpec, state: str) -> None:
         if not GLOBAL_CONFIG.task_events_enabled:
             return
-        self._task_events.append(
-            {
-                "task_id": spec.task_id.binary(),
-                "name": spec.name,
-                "state": state,
-                "ts": time.time(),
-            }
-        )
-        if not self._task_events_flushing:
-            self._task_events_flushing = True
+        ev = {
+            "task_id": spec.task_id.binary(),
+            "name": spec.name,
+            "state": state,
+            "ts": time.time(),
+        }
+        with self._task_events_lock:
+            self._task_events.append(ev)
+            schedule = not self._task_events_flushing
+            if schedule:
+                self._task_events_flushing = True
+        if schedule:
             self.io.post(self._flush_task_events())
 
     async def _flush_task_events(self) -> None:
         try:
             await asyncio.sleep(0.2)  # batch window
-            events, self._task_events = self._task_events, []
+            with self._task_events_lock:
+                events, self._task_events = self._task_events, []
             if events:
                 await self.controller.call(
                     "task_events", {"events": events}, timeout=10
@@ -732,10 +747,12 @@ class CoreWorker(RuntimeBackend):
         finally:
             # events that arrived while the RPC was in flight must not
             # strand in the buffer until the next emit — reschedule
-            if self._task_events and not self._stopping:
+            with self._task_events_lock:
+                again = bool(self._task_events) and not self._stopping
+                if not again:
+                    self._task_events_flushing = False
+            if again:
                 self.io.post(self._flush_task_events())
-            else:
-                self._task_events_flushing = False
 
     async def _acquire_lease(self, spec: TaskSpec) -> Dict[str, Any]:
         """Lease with spillback-following (reference lease protocol).

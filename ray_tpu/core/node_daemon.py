@@ -190,7 +190,7 @@ class NodeDaemon:
                 g_avail.set(val, {"node": nid, "resource": res})
 
         self._metrics_cb = on_collect(sample)
-        self._metrics_server = MetricsServer(port=GLOBAL_CONFIG.metrics_port)
+        self._metrics_server = MetricsServer(host=GLOBAL_CONFIG.metrics_bind_host, port=GLOBAL_CONFIG.metrics_port)
         self.metrics_port = self._metrics_server.port
         logger.info("metrics at http://127.0.0.1:%d/metrics", self.metrics_port)
 
@@ -225,7 +225,11 @@ class NodeDaemon:
     async def _log_tail_loop(self) -> None:
         """Tail this node's worker log files and forward new lines to the
         controller for driver display (reference ``LogMonitor``,
-        ``_private/log_monitor.py:103``)."""
+        ``_private/log_monitor.py:103``).
+
+        Known limitation vs the reference: lines are not tagged with a
+        job id, so in a multi-driver cluster every driver sees every
+        worker's output (the reference filters per job)."""
         if not GLOBAL_CONFIG.log_to_driver:
             return
         import glob as _glob

@@ -32,6 +32,11 @@ class Metric:
         with _REGISTRY_LOCK:
             existing = _METRICS.get(name)
             if existing is not None:
+                if existing.labelnames != self.labelnames:
+                    raise ValueError(
+                        f"metric {name!r} re-registered with different labels "
+                        f"{self.labelnames} != {existing.labelnames}"
+                    )
                 # re-registration returns the same underlying metric
                 self._values = existing._values
                 self._lock = existing._lock
