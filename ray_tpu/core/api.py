@@ -271,6 +271,13 @@ class Worker:
         num_returns = opts.num_returns if opts.num_returns is not None else 1
         if isinstance(num_returns, int):
             return_ids = [ObjectID.from_index(task_id, i + 1) for i in range(num_returns)]
+        elif num_returns == "streaming":
+            if kind != TaskKind.NORMAL:
+                raise ValueError(
+                    'num_returns="streaming" is only supported on normal '
+                    "tasks (not actor methods) in this build"
+                )
+            return_ids = []  # item ids are generated as the task yields
         else:
             return_ids = [ObjectID.from_index(task_id, 1)]
         max_retries = (
@@ -278,6 +285,10 @@ class Worker:
             if opts.max_retries is not None
             else (GLOBAL_CONFIG.task_max_retries if kind == TaskKind.NORMAL else 0)
         )
+        if num_returns == "streaming":
+            # re-executing a partially-consumed stream has replay
+            # semantics this build doesn't implement — no retries
+            max_retries = 0
         return TaskSpec(
             kind=kind,
             task_id=task_id,
@@ -307,6 +318,14 @@ class Worker:
 
     def submit_task(self, function_obj, name, args, kwargs, opts: TaskOptions):
         spec = self.make_task_spec(TaskKind.NORMAL, function_obj, name, args, kwargs, opts)
+        if spec.num_returns == "streaming":
+            from ray_tpu.core.streaming import ObjectRefGenerator
+
+            self.backend.create_stream(spec)
+            self.backend.submit_task(spec)
+            return ObjectRefGenerator(
+                self.backend, spec.task_id.binary(), self.address
+            )
         self.backend.submit_task(spec)
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids]
         self.backend.release_hold(spec.return_ids)

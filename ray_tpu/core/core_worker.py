@@ -116,6 +116,12 @@ class CoreWorker(RuntimeBackend):
         # lease-reuse submission (per scheduling class)
         self._class_queues: Dict[Any, "_ClassQueue"] = {}
         self._retries_left: Dict[bytes, int] = {}
+        # streaming generators (``task_manager.h:102`` ObjectRefStream).
+        # Locked: item pushes land on the io loop while abandon runs on
+        # the consumer/GC thread — an unordered pop could leak the hold
+        # created for an in-flight item.
+        self._streams: Dict[bytes, Any] = {}
+        self._streams_lock = threading.Lock()
         # task-event buffer (``core_worker/task_event_buffer`` →
         # ``GcsTaskManager``): batched lifecycle events for `list tasks`.
         # Locked: emitters run on lane/user threads, the flusher swaps the
@@ -168,6 +174,10 @@ class CoreWorker(RuntimeBackend):
         c = self._clients.get(key)
         if c is None:
             c = self._clients[key] = RpcClient(host, port, name=f"peer-{port}")
+            # stream items ride back over the submission connection
+            from ray_tpu.core.streaming import STREAM_PUSH_CHANNEL
+
+            c.subscribe_push(STREAM_PUSH_CHANNEL, self._on_stream_item)
         return c
 
     def _owner_client(self, ref: ObjectRef) -> RpcClient:
@@ -715,6 +725,71 @@ class CoreWorker(RuntimeBackend):
         self.emit_task_event(spec, "FAILED" if error is not None else "FINISHED")
 
     # ------------------------------------------------------------------
+    # streaming generators (owner side)
+    def create_stream(self, spec: TaskSpec):
+        from ray_tpu.core.streaming import ObjectRefStream
+
+        stream = ObjectRefStream(spec.task_id.binary())
+        with self._streams_lock:
+            self._streams[spec.task_id.binary()] = stream
+        return stream
+
+    def stream_next(self, task_id: bytes, index: int, timeout: Optional[float]):
+        from ray_tpu.core.streaming import _END
+
+        with self._streams_lock:
+            stream = self._streams.get(task_id)
+        if stream is None:
+            raise RayTpuError("unknown stream (task already cleaned up?)")
+        out = stream.next_blocking(index, timeout)
+        if out is _END:
+            # last consumer position reached: drop the stream record
+            with self._streams_lock:
+                self._streams.pop(task_id, None)
+        return out
+
+    def abandon_stream(self, task_id: bytes, consumed_pos: int) -> None:
+        """Generator dropped before exhaustion: release holds on items the
+        consumer never took. Holds the streams lock so an item push racing
+        the abandonment can't create a hold nobody releases."""
+        with self._streams_lock:
+            stream = self._streams.pop(task_id, None)
+            if stream is None:
+                return
+            with stream._cond:
+                undelivered = [
+                    oid for idx, oid in stream._items.items() if idx > consumed_pos
+                ]
+        self.release_hold(undelivered)
+
+    def _on_stream_item(self, msg: Dict[str, Any]) -> None:
+        """Worker-pushed stream item: record the value + ref."""
+        task_id = msg["task_id"]
+        oid = ObjectID(msg["object_id"])
+        with self._streams_lock:
+            stream = self._streams.get(task_id)
+            if stream is None:
+                return  # stream abandoned — drop
+            # entry holds until the generator hands out the real
+            # ObjectRef; created under the lock so abandon_stream either
+            # sees this item (and releases it) or this push sees the
+            # stream already gone
+            self.refcounter.create_pending(oid, hold=True)
+            stream.append(msg["index"], oid)
+        if msg["kind"] == "inline":
+            self.memory.put(oid, msg["data"])
+            self.refcounter.mark_available_inline(oid, msg["data"])
+        else:
+            self.refcounter.mark_available_at(oid, tuple(msg["location"]))
+
+    def _finalize_stream(self, spec: TaskSpec, error: Optional[Exception]) -> None:
+        stream = self._streams.get(spec.task_id.binary())
+        if stream is None:
+            return
+        if error is not None:
+            stream.fail(error)
+
+    # ------------------------------------------------------------------
     # task events (batched → controller; reference task_event_buffer)
     def emit_task_event(self, spec: TaskSpec, state: str) -> None:
         if not GLOBAL_CONFIG.task_events_enabled:
@@ -843,6 +918,15 @@ class CoreWorker(RuntimeBackend):
                 if isinstance(err, TaskError) and self._should_retry_app_error(spec, err, retries_left):
                     return True
         for oid_bytes, kind, payload in results:
+            if kind == "stream_end":
+                stream = self._streams.get(spec.task_id.binary())
+                if stream is not None:
+                    stream.complete(payload)  # payload = total item count
+                continue
+            if kind == "error" and spec.num_returns == "streaming":
+                # streams have no fixed return ids — fail the stream itself
+                self._finalize_stream(spec, pickle.loads(payload))
+                continue
             oid = ObjectID(oid_bytes)
             if kind == "inline":
                 self.memory.put(oid, payload)
@@ -866,6 +950,8 @@ class CoreWorker(RuntimeBackend):
     def _fail_returns(self, spec: TaskSpec, error: Exception) -> None:
         for oid in spec.return_ids:
             self.refcounter.mark_failed(oid, error)
+        if spec.num_returns == "streaming":
+            self._finalize_stream(spec, error)
 
     # ------------------------------------------------------------------
     # actors
@@ -1277,24 +1363,24 @@ class CoreWorker(RuntimeBackend):
         replies = []
         for spec in payload["specs"]:
             try:
-                replies.append(await self.executor.handle_push_task(spec))
+                replies.append(await self.executor.handle_push_task(spec, conn=conn))
             except Exception as e:  # noqa: BLE001
                 logger.exception("task %s failed in batch", spec.name)
                 err = TaskError(spec.name, e)
-                replies.append(
-                    {
-                        "results": [
-                            (oid.binary(), "error", pickle.dumps(err))
-                            for oid in spec.return_ids
-                        ]
-                    }
-                )
+                if spec.num_returns == "streaming":
+                    results = [(b"", "error", pickle.dumps(err))]
+                else:
+                    results = [
+                        (oid.binary(), "error", pickle.dumps(err))
+                        for oid in spec.return_ids
+                    ]
+                replies.append({"results": results})
         return {"replies": replies}
 
     async def w_push_task(self, payload, conn):
         if self.executor is None:
             raise RuntimeError("this process does not execute tasks")
-        return await self.executor.handle_push_task(payload["spec"])
+        return await self.executor.handle_push_task(payload["spec"], conn=conn)
 
     async def w_run_actor_creation(self, payload, conn):
         if self.executor is None:

@@ -34,6 +34,7 @@ class LocalBackend(RuntimeBackend):
         self._dead_actors: Dict[ActorID, str] = {}
         self._named: Dict[Tuple[str, str], Tuple[ActorID, dict, Any]] = {}
         self._refcounts: Dict[ObjectID, int] = {}
+        self._streams: Dict[bytes, Any] = {}
         self._lock = threading.RLock()
         self._worker: Optional[Worker] = None
 
@@ -107,11 +108,63 @@ class LocalBackend(RuntimeBackend):
             with self._lock:
                 for oid in spec.return_ids:
                     self._store[oid] = e
+            if spec.num_returns == "streaming":
+                stream = self._streams.get(spec.task_id.binary())
+                if stream is not None:
+                    stream.fail(e)
+            return
+        if spec.num_returns == "streaming":
+            stream = self._streams[spec.task_id.binary()]
+            count = 0
+            try:
+                for value in fn(*args, **kwargs):
+                    count += 1
+                    oid = ObjectID.from_index(spec.task_id, count)
+                    with self._lock:
+                        self._store_result(oid, value)
+                    stream.append(count, oid)
+            except Exception as e:  # noqa: BLE001
+                stream.fail(TaskError(spec.name, e))
+                return
+            stream.complete(count)
             return
         results = execution.run_function(spec, fn, args, kwargs)
         with self._lock:
             for oid, value in results:
                 self._store_result(oid, value)
+
+    # ---- streaming ------------------------------------------------------
+    def create_stream(self, spec: TaskSpec):
+        from ray_tpu.core.streaming import ObjectRefStream
+
+        stream = ObjectRefStream(spec.task_id.binary())
+        self._streams[spec.task_id.binary()] = stream
+        return stream
+
+    def stream_next(self, task_id: bytes, index: int, timeout):
+        from ray_tpu.core.streaming import _END
+
+        stream = self._streams.get(task_id)
+        if stream is None:
+            raise RuntimeError("unknown stream")
+        out = stream.next_blocking(index, timeout)
+        if out is _END:
+            self._streams.pop(task_id, None)
+        return out
+
+    def abandon_stream(self, task_id: bytes, consumed_pos: int) -> None:
+        """Drop a partially-consumed stream: free undelivered items."""
+        stream = self._streams.pop(task_id, None)
+        if stream is None:
+            return
+        with stream._cond:
+            undelivered = [
+                oid for idx, oid in stream._items.items() if idx > consumed_pos
+            ]
+        with self._lock:
+            for oid in undelivered:
+                if oid not in self._refcounts:
+                    self._store.pop(oid, None)
 
     # ---- actors --------------------------------------------------------
     def create_actor(self, spec: TaskSpec) -> None:

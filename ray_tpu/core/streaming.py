@@ -1,0 +1,105 @@
+"""Streaming generators: ``num_returns="streaming"``.
+
+Reference: ``core_worker/task_manager.h:102`` (``ObjectRefStream``) and
+the Cython generator execution path (``_raylet.pyx:1345``) — a generator
+task's yields become ObjectRefs the caller consumes WHILE the task still
+runs. The executing worker pushes each item back over the submission
+connection (ordered by TCP); the owner records them in an
+``ObjectRefStream`` and hands them out through an ``ObjectRefGenerator``.
+
+Retries are disabled for streaming tasks in this build (re-executing a
+partially-consumed stream has replay semantics the reference spent a
+protocol on; a died worker surfaces as the stream erroring)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ray_tpu.core.exceptions import GetTimeoutError
+from ray_tpu.core.ids import ObjectID
+
+#: push channel on worker→owner connections carrying stream items
+STREAM_PUSH_CHANNEL = 10
+
+_END = object()
+
+
+class ObjectRefStream:
+    """Owner-side record of one streaming task's yielded refs."""
+
+    def __init__(self, task_id: bytes):
+        self.task_id = task_id
+        self._items: Dict[int, ObjectID] = {}  # 1-based index -> object id
+        self._total: Optional[int] = None
+        self._error: Optional[Exception] = None
+        self._cond = threading.Condition()
+
+    def append(self, index: int, object_id: ObjectID) -> None:
+        with self._cond:
+            self._items[index] = object_id
+            self._cond.notify_all()
+
+    def complete(self, total: int) -> None:
+        with self._cond:
+            self._total = total
+            self._cond.notify_all()
+
+    def fail(self, error: Exception) -> None:
+        with self._cond:
+            self._error = error
+            self._cond.notify_all()
+
+    def next_blocking(self, index: int, timeout: Optional[float]):
+        """Block until item ``index`` exists; returns its ObjectID,
+        ``_END`` past the last item, or raises the stream error."""
+        with self._cond:
+            while True:
+                if index in self._items:
+                    return self._items[index]
+                if self._error is not None:
+                    raise self._error
+                if self._total is not None and index > self._total:
+                    return _END
+                if not self._cond.wait(timeout):
+                    raise GetTimeoutError(
+                        f"stream item {index} not produced in time"
+                    )
+
+
+class ObjectRefGenerator:
+    """User-facing iterator over a streaming task's item refs
+    (reference ``ObjectRefGenerator``)."""
+
+    def __init__(self, backend, task_id: bytes, owner_address):
+        self._backend = backend
+        self._task_id = task_id
+        self._owner = owner_address
+        self._pos = 0
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self):
+        from ray_tpu.core.refs import ObjectRef
+
+        self._pos += 1
+        oid = self._backend.stream_next(self._task_id, self._pos, timeout=None)
+        if oid is _END:
+            raise StopIteration
+        ref = ObjectRef(oid, self._owner)
+        self._backend.release_hold([oid])
+        return ref
+
+    def __del__(self):
+        # Abandoned before exhaustion: release the owner-side holds on
+        # items never handed out, or they pin memory forever.
+        try:
+            abandon = getattr(self._backend, "abandon_stream", None)
+            if abandon is not None:
+                abandon(self._task_id, self._pos)
+        except Exception:
+            pass
+
+    def __repr__(self) -> str:
+        return f"ObjectRefGenerator({self._task_id.hex()[:16]}, pos={self._pos})"

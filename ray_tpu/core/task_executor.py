@@ -112,10 +112,24 @@ class TaskExecutor:
         return {"ok": True}
 
     # ------------------------------------------------------------------
-    async def handle_push_task(self, spec: TaskSpec) -> Dict[str, Any]:
+    async def handle_push_task(self, spec: TaskSpec, conn=None) -> Dict[str, Any]:
         if spec.kind == TaskKind.ACTOR_TASK:
             return await self._handle_actor_task(spec)
         logger.debug("executing %s %s", spec.name, spec.task_id.hex()[:8])
+        emit = None
+        if spec.num_returns == "streaming" and conn is not None:
+            # stream items push back over the submission connection,
+            # ordered by TCP (reference: generator returns stream through
+            # the reply channel, _raylet.pyx:1345)
+            from ray_tpu.core.streaming import STREAM_PUSH_CHANNEL
+
+            loop_ = asyncio.get_event_loop()
+
+            def emit(payload):  # runs on the lane thread
+                asyncio.run_coroutine_threadsafe(
+                    conn.push(STREAM_PUSH_CHANNEL, payload), loop_
+                ).result(timeout=60)
+
         # Normal tasks run on the pooled lane (thread spawn per task costs
         # real throughput). Cancellation safety: cancel_task delivers
         # TaskCancelledError via PyThreadState_SetAsyncExc and immediately
@@ -124,7 +138,9 @@ class TaskExecutor:
         # a later task. The lane holds at most the one running task (the
         # lease protocol serializes pushes), so nothing queued is lost.
         loop = asyncio.get_event_loop()
-        results = await loop.run_in_executor(self._default_lane, self._execute, spec)
+        results = await loop.run_in_executor(
+            self._default_lane, self._execute, spec, emit
+        )
         logger.debug("finished %s %s", spec.name, spec.task_id.hex()[:8])
         return {"results": results}
 
@@ -217,13 +233,13 @@ class TaskExecutor:
         return {"results": await loop.run_in_executor(None, self._package, spec, pairs)}
 
     # ------------------------------------------------------------------
-    def _execute(self, spec: TaskSpec) -> List[Tuple[bytes, str, Any]]:
+    def _execute(self, spec: TaskSpec, emit=None) -> List[Tuple[bytes, str, Any]]:
         """Runs on a lane thread. Returns packaged results."""
         from ray_tpu.observability import timeline as _timeline
 
         _start_us = _timeline._now_us()
         try:
-            return self._execute_inner(spec)
+            return self._execute_inner(spec, emit)
         finally:
             _timeline.record_event(
                 f"task::{spec.name}",
@@ -265,18 +281,26 @@ class TaskExecutor:
             )
         return True
 
-    def _execute_inner(self, spec: TaskSpec) -> List[Tuple[bytes, str, Any]]:
+    def _execute_inner(self, spec: TaskSpec, emit=None) -> List[Tuple[bytes, str, Any]]:
         self.api_worker.job_id = spec.job_id
         self.api_worker.set_task_context(spec.task_id, spec.job_id)
         tid = spec.task_id.binary()
+
+        def error_results(err) -> List[Tuple[bytes, str, Any]]:
+            # streaming specs have no fixed return ids: the error must
+            # still reach the owner (as a stream failure) or the consumer
+            # blocks forever on a stream that never finalizes
+            if spec.num_returns == "streaming":
+                return [(b"", "error", pickle.dumps(err))]
+            return [
+                (oid.binary(), "error", pickle.dumps(err))
+                for oid in spec.return_ids
+            ]
+
         with self._cancel_lock:
             if tid in self._cancelled:
                 self._cancelled.discard(tid)  # consumed — don't grow forever
-                err = TaskCancelledError(spec.task_id.hex()[:16])
-                return [
-                    (oid.binary(), "error", pickle.dumps(err))
-                    for oid in spec.return_ids
-                ]
+                return error_results(TaskCancelledError(spec.task_id.hex()[:16]))
             if len(self._cancelled) > 4096:
                 self._cancelled.clear()  # stale marks on a long-lived worker
             if spec.kind != TaskKind.ACTOR_TASK:
@@ -294,14 +318,12 @@ class TaskExecutor:
                     fn = self.api_worker.fn_table.load(spec.function_id)
                 args, kwargs = execution.resolve_args(spec, self._get_dep)
             except TaskCancelledError:
-                err = TaskCancelledError(spec.task_id.hex()[:16])
-                return [
-                    (oid.binary(), "error", pickle.dumps(err))
-                    for oid in spec.return_ids
-                ]
+                return error_results(TaskCancelledError(spec.task_id.hex()[:16]))
             except Exception as e:  # noqa: BLE001
                 err = e if isinstance(e, TaskError) else TaskError(spec.name, e)
-                return [(oid.binary(), "error", pickle.dumps(err)) for oid in spec.return_ids]
+                return error_results(err)
+            if spec.num_returns == "streaming":
+                return self._execute_streaming(spec, fn, args, kwargs, emit)
             pairs = execution.run_function(spec, fn, args, kwargs)
         finally:
             with self._cancel_lock:
@@ -316,6 +338,62 @@ class TaskExecutor:
                 value = TaskCancelledError(spec.task_id.hex()[:16])
             out.append((oid, value))
         return self._package(spec, out)
+
+    def _execute_streaming(
+        self, spec: TaskSpec, fn, args, kwargs, emit
+    ) -> List[Tuple[bytes, str, Any]]:
+        """Generator task: each yielded value becomes an ObjectRef pushed
+        to the owner IMMEDIATELY (consumable before the task finishes);
+        the reply carries only the end-of-stream marker."""
+        if emit is None:
+            err = TaskError(
+                spec.name,
+                RuntimeError("streaming task executed without a stream channel"),
+            )
+            return [(b"", "error", pickle.dumps(err))]
+        count = 0
+        try:
+            result = fn(*args, **kwargs)
+            if not inspect.isgenerator(result) and not hasattr(result, "__iter__"):
+                raise TypeError(
+                    f"num_returns='streaming' task {spec.name} must return "
+                    f"a generator/iterable, got {type(result).__name__}"
+                )
+            for value in result:
+                count += 1
+                oid = ObjectID.from_index(spec.task_id, count)
+                ser = serialization.serialize(value)
+                if ser.total_bytes <= GLOBAL_CONFIG.max_direct_call_object_size:
+                    emit(
+                        {
+                            "task_id": spec.task_id.binary(),
+                            "index": count,
+                            "object_id": oid.binary(),
+                            "kind": "inline",
+                            "data": ser.to_bytes(),
+                        }
+                    )
+                else:
+                    size = self.core.shm.create_and_write(oid, ser)
+                    self.core.io.run(
+                        self.core.daemon.call(
+                            "adopt_object", {"object_id": oid.binary(), "size": size}
+                        )
+                    )
+                    self.core.shm.release(oid)
+                    emit(
+                        {
+                            "task_id": spec.task_id.binary(),
+                            "index": count,
+                            "object_id": oid.binary(),
+                            "kind": "shm",
+                            "location": self.core._self_location(),
+                        }
+                    )
+        except Exception as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError(spec.name, e)
+            return [(b"", "error", pickle.dumps(err))]
+        return [(b"", "stream_end", count)]
 
     def _package(self, spec: TaskSpec, pairs: List[Tuple[ObjectID, Any]]) -> List[Tuple[bytes, str, Any]]:
         out: List[Tuple[bytes, str, Any]] = []
