@@ -44,7 +44,7 @@ from ray_tpu.core.exceptions import (
     TaskError,
     WorkerCrashedError,
 )
-from ray_tpu.core.ids import ActorID, NodeID, ObjectID, WorkerID
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import MemoryStore, StoreClient
 from ray_tpu.core.ownership import ObjState, ReferenceCounter
 from ray_tpu.core.refs import Address, ObjectRef
@@ -750,17 +750,21 @@ class CoreWorker(RuntimeBackend):
 
     def abandon_stream(self, task_id: bytes, consumed_pos: int) -> None:
         """Generator dropped before exhaustion: release holds on items the
-        consumer never took. Holds the streams lock so an item push racing
-        the abandonment can't create a hold nobody releases."""
+        consumer never took and cancel the producer (no point computing a
+        stream nobody reads). Holds the streams lock so an item push
+        racing the abandonment can't create a hold nobody releases."""
         with self._streams_lock:
             stream = self._streams.pop(task_id, None)
             if stream is None:
                 return
             with stream._cond:
-                undelivered = [
-                    oid for idx, oid in stream._items.items() if idx > consumed_pos
-                ]
+                undelivered = list(stream._items.values())
         self.release_hold(undelivered)
+        # cooperative-cancel the still-running producer task
+        try:
+            self._cancel_owned(ObjectID.from_index(TaskID(task_id), 1), force=False)
+        except Exception:
+            pass
 
     def _on_stream_item(self, msg: Dict[str, Any]) -> None:
         """Worker-pushed stream item: record the value + ref."""
@@ -769,7 +773,12 @@ class CoreWorker(RuntimeBackend):
         with self._streams_lock:
             stream = self._streams.get(task_id)
             if stream is None:
-                return  # stream abandoned — drop
+                # stream abandoned: a late shm item would otherwise sit in
+                # the producing node's store forever — best-effort delete
+                if msg["kind"] == "shm":
+                    _nid, host, port = msg["location"]
+                    self.io.post(self._delete_remote(host, port, oid))
+                return
             # entry holds until the generator hands out the real
             # ObjectRef; created under the lock so abandon_stream either
             # sees this item (and releases it) or this push sees the

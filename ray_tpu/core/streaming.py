@@ -9,7 +9,14 @@ connection (ordered by TCP); the owner records them in an
 
 Retries are disabled for streaming tasks in this build (re-executing a
 partially-consumed stream has replay semantics the reference spent a
-protocol on; a died worker surfaces as the stream erroring)."""
+protocol on; a died worker surfaces as the stream erroring).
+
+Known limitation vs the reference: no producer-side backpressure — a
+fast generator can outrun a slow consumer and grow the owner's buffer
+to the unconsumed backlog (the reference pauses generators at a
+configurable in-flight count). Consumed entries are trimmed, and
+abandoning the generator cancels the producer, so the backlog is
+bounded by the consumer's lag, not the stream length."""
 
 from __future__ import annotations
 
@@ -23,6 +30,16 @@ from ray_tpu.core.ids import ObjectID
 STREAM_PUSH_CHANNEL = 10
 
 _END = object()
+
+
+def streaming_error_result(err) -> tuple:
+    """The wire shape for a stream-level failure: streaming specs have no
+    fixed return ids, so the empty-oid sentinel routes the error to the
+    stream itself (matched in ``CoreWorker._process_reply``). Single
+    source — executor and batch paths must agree on this shape."""
+    import pickle
+
+    return (b"", "error", pickle.dumps(err))
 
 
 class ObjectRefStream:
@@ -52,11 +69,13 @@ class ObjectRefStream:
 
     def next_blocking(self, index: int, timeout: Optional[float]):
         """Block until item ``index`` exists; returns its ObjectID,
-        ``_END`` past the last item, or raises the stream error."""
+        ``_END`` past the last item, or raises the stream error. The
+        consumed entry is dropped so the map holds only the unconsumed
+        backlog, not the whole stream history."""
         with self._cond:
             while True:
                 if index in self._items:
-                    return self._items[index]
+                    return self._items.pop(index)
                 if self._error is not None:
                     raise self._error
                 if self._total is not None and index > self._total:

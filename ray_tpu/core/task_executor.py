@@ -291,7 +291,9 @@ class TaskExecutor:
             # still reach the owner (as a stream failure) or the consumer
             # blocks forever on a stream that never finalizes
             if spec.num_returns == "streaming":
-                return [(b"", "error", pickle.dumps(err))]
+                from ray_tpu.core.streaming import streaming_error_result
+
+                return [streaming_error_result(err)]
             return [
                 (oid.binary(), "error", pickle.dumps(err))
                 for oid in spec.return_ids
@@ -345,12 +347,14 @@ class TaskExecutor:
         """Generator task: each yielded value becomes an ObjectRef pushed
         to the owner IMMEDIATELY (consumable before the task finishes);
         the reply carries only the end-of-stream marker."""
+        from ray_tpu.core.streaming import streaming_error_result
+
         if emit is None:
             err = TaskError(
                 spec.name,
                 RuntimeError("streaming task executed without a stream channel"),
             )
-            return [(b"", "error", pickle.dumps(err))]
+            return [streaming_error_result(err)]
         count = 0
         try:
             result = fn(*args, **kwargs)
@@ -362,38 +366,42 @@ class TaskExecutor:
             for value in result:
                 count += 1
                 oid = ObjectID.from_index(spec.task_id, count)
-                ser = serialization.serialize(value)
-                if ser.total_bytes <= GLOBAL_CONFIG.max_direct_call_object_size:
-                    emit(
-                        {
-                            "task_id": spec.task_id.binary(),
-                            "index": count,
-                            "object_id": oid.binary(),
-                            "kind": "inline",
-                            "data": ser.to_bytes(),
-                        }
-                    )
-                else:
-                    size = self.core.shm.create_and_write(oid, ser)
-                    self.core.io.run(
-                        self.core.daemon.call(
-                            "adopt_object", {"object_id": oid.binary(), "size": size}
-                        )
-                    )
-                    self.core.shm.release(oid)
-                    emit(
-                        {
-                            "task_id": spec.task_id.binary(),
-                            "index": count,
-                            "object_id": oid.binary(),
-                            "kind": "shm",
-                            "location": self.core._self_location(),
-                        }
-                    )
+                kind, payload = self._store_value(oid, value, spec.name)
+                if kind == "error":
+                    return [streaming_error_result(pickle.loads(payload))]
+                emit(
+                    {
+                        "task_id": spec.task_id.binary(),
+                        "index": count,
+                        "object_id": oid.binary(),
+                        "kind": kind,
+                        "data" if kind == "inline" else "location": payload,
+                    }
+                )
         except Exception as e:  # noqa: BLE001
             err = e if isinstance(e, TaskError) else TaskError(spec.name, e)
-            return [(b"", "error", pickle.dumps(err))]
+            return [streaming_error_result(err)]
         return [(b"", "stream_end", count)]
+
+    def _store_value(self, oid: ObjectID, value: Any, name: str = "") -> Tuple[str, Any]:
+        """Promote one result value: inline bytes under the threshold,
+        else a sealed shm object adopted by the daemon. Shared by the
+        reply packager and the streaming item path so the promotion
+        protocol can never diverge between them."""
+        try:
+            ser = serialization.serialize(value)
+        except Exception as e:  # noqa: BLE001
+            return ("error", pickle.dumps(TaskError(name or "serialize", e)))
+        if ser.total_bytes <= GLOBAL_CONFIG.max_direct_call_object_size:
+            return ("inline", ser.to_bytes())
+        size = self.core.shm.create_and_write(oid, ser)
+        self.core.io.run(
+            self.core.daemon.call(
+                "adopt_object", {"object_id": oid.binary(), "size": size}
+            )
+        )
+        self.core.shm.release(oid)
+        return ("shm", self.core._self_location())
 
     def _package(self, spec: TaskSpec, pairs: List[Tuple[ObjectID, Any]]) -> List[Tuple[bytes, str, Any]]:
         out: List[Tuple[bytes, str, Any]] = []
@@ -401,22 +409,8 @@ class TaskExecutor:
             if isinstance(value, (TaskError, TaskCancelledError)):
                 out.append((oid.binary(), "error", pickle.dumps(value)))
                 continue
-            try:
-                ser = serialization.serialize(value)
-            except Exception as e:  # noqa: BLE001
-                out.append((oid.binary(), "error", pickle.dumps(TaskError(spec.name, e))))
-                continue
-            if ser.total_bytes <= GLOBAL_CONFIG.max_direct_call_object_size:
-                out.append((oid.binary(), "inline", ser.to_bytes()))
-            else:
-                size = self.core.shm.create_and_write(oid, ser)
-                self.core.io.run(
-                    self.core.daemon.call(
-                        "adopt_object", {"object_id": oid.binary(), "size": size}
-                    )
-                )
-                self.core.shm.release(oid)
-                out.append((oid.binary(), "shm", self.core._self_location()))
+            kind, payload = self._store_value(oid, value, spec.name)
+            out.append((oid.binary(), kind, payload))
         return out
 
 

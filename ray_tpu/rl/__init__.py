@@ -1,0 +1,16 @@
+"""ray_tpu.rl — reinforcement learning: EnvRunner actors + JAX learners.
+
+Reference: ``rllib/`` new API stack (Algorithm / EnvRunnerGroup /
+LearnerGroup). See ``ppo.py`` for the TPU-native design notes."""
+
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.models import apply_mlp_policy, init_mlp_policy
+from ray_tpu.rl.ppo import PPO, PPOConfig
+
+__all__ = [
+    "EnvRunner",
+    "PPO",
+    "PPOConfig",
+    "apply_mlp_policy",
+    "init_mlp_policy",
+]

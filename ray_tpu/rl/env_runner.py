@@ -1,0 +1,109 @@
+"""EnvRunner: rollout-collection actors.
+
+Reference: ``rllib/env/env_runner_group.py:70`` +
+``SingleAgentEnvRunner`` — CPU actors step gymnasium vector envs with
+the current policy and return episode batches; learning happens
+elsewhere (the reference's Learner gang; here a JAX learner)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+
+
+class _EnvRunner:
+    """One rollout actor: a gymnasium vector env + jitted policy apply.
+
+    Defined undecorated so cloudpickle exports by module reference."""
+
+    def __init__(self, env_name: str, num_envs: int, seed: int, env_config=None):
+        import gymnasium as gym
+
+        self._envs = gym.make_vec(env_name, num_envs=num_envs, **(env_config or {}))
+        self._num_envs = num_envs
+        self._obs, _ = self._envs.reset(seed=seed)
+        self._rng = np.random.default_rng(seed)
+        self._apply = None
+        self._episode_returns = np.zeros(num_envs)
+        self._finished_returns: List[float] = []
+
+    def _policy(self):
+        if self._apply is None:
+            import jax
+
+            # Rollout actors are CPU workers (reference: EnvRunners are
+            # CPU-only; learners own the accelerator) — never let a tiny
+            # policy apply claim the TPU from a pool worker.
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass  # backend already initialized in this process
+
+            from ray_tpu.rl.models import apply_mlp_policy
+
+            self._apply = jax.jit(apply_mlp_policy)
+        return self._apply
+
+    def sample(self, params, num_steps: int) -> Dict[str, Any]:
+        """Collect ``num_steps`` vector steps with the given policy params.
+
+        Returns time-major arrays [T, N, ...] plus bootstrap values and
+        episode-return stats (the learner computes GAE)."""
+        import jax.numpy as jnp
+
+        apply = self._policy()
+        obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = [], [], [], [], [], []
+        for _ in range(num_steps):
+            logits, value = apply(params, jnp.asarray(self._obs, jnp.float32))
+            logits = np.asarray(logits)
+            value = np.asarray(value)
+            # sample actions from the categorical (gumbel trick, numpy rng)
+            z = self._rng.gumbel(size=logits.shape)
+            actions = np.argmax(logits + z, axis=-1)
+            logp = logits - _logsumexp(logits)
+            act_logp = np.take_along_axis(logp, actions[:, None], axis=1)[:, 0]
+
+            next_obs, rewards, terminated, truncated, _ = self._envs.step(actions)
+            dones = np.logical_or(terminated, truncated)
+
+            obs_buf.append(self._obs)
+            act_buf.append(actions)
+            rew_buf.append(rewards)
+            done_buf.append(dones)
+            logp_buf.append(act_logp)
+            val_buf.append(value)
+
+            self._episode_returns += rewards
+            for i, d in enumerate(dones):
+                if d:
+                    self._finished_returns.append(float(self._episode_returns[i]))
+                    self._episode_returns[i] = 0.0
+            self._obs = next_obs
+
+        _, last_value = apply(params, jnp.asarray(self._obs, jnp.float32))
+        finished, self._finished_returns = self._finished_returns, []
+        return {
+            "obs": np.asarray(obs_buf, np.float32),
+            "actions": np.asarray(act_buf, np.int64),
+            "rewards": np.asarray(rew_buf, np.float32),
+            "dones": np.asarray(done_buf, np.bool_),
+            "logp": np.asarray(logp_buf, np.float32),
+            "values": np.asarray(val_buf, np.float32),
+            "last_values": np.asarray(last_value, np.float32),
+            "episode_returns": finished,
+        }
+
+    def close(self) -> bool:
+        self._envs.close()
+        return True
+
+
+def _logsumexp(x: np.ndarray) -> np.ndarray:
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))
+
+
+EnvRunner = ray_tpu.remote(_EnvRunner)

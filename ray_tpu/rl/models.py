@@ -1,0 +1,53 @@
+"""RL models: pure-JAX MLP policy/value networks.
+
+Reference: ``rllib/core/rl_module/`` (RLModule abstraction; torch).
+TPU-native: params are plain pytrees, ``apply`` is jit/pjit-able, and
+the same function serves actors (CPU rollout) and learners (TPU)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp_policy(
+    rng: jax.Array,
+    obs_dim: int,
+    num_actions: int,
+    hidden: Sequence[int] = (64, 64),
+) -> Dict[str, Any]:
+    """Shared torso + policy logits head + value head."""
+    params: Dict[str, Any] = {"layers": [], "pi": None, "vf": None}
+    sizes = [obs_dim, *hidden]
+    keys = jax.random.split(rng, len(hidden) + 2)
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        scale = math.sqrt(2.0 / fan_in)
+        params["layers"].append(
+            {
+                "w": jax.random.normal(keys[i], (fan_in, fan_out)) * scale,
+                "b": jnp.zeros((fan_out,)),
+            }
+        )
+    last = sizes[-1]
+    params["pi"] = {
+        "w": jax.random.normal(keys[-2], (last, num_actions)) * 0.01,
+        "b": jnp.zeros((num_actions,)),
+    }
+    params["vf"] = {
+        "w": jax.random.normal(keys[-1], (last, 1)) * 1.0,
+        "b": jnp.zeros((1,)),
+    }
+    return params
+
+
+def apply_mlp_policy(params: Dict[str, Any], obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """obs [B, obs_dim] → (logits [B, A], value [B])."""
+    x = obs
+    for layer in params["layers"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
