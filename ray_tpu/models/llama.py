@@ -44,6 +44,12 @@ class LlamaConfig:
     #: "ring" | "ulysses" (sequence-parallel over the mesh's seq axis —
     #: pass the mesh to ``forward``/``make_train_step``)
     attention_impl: str = "auto"
+    #: >0 turns every MLP block into a MoE FFN with this many experts
+    #: (expert dim shards over the ``expert`` mesh axis — see ops/moe.py)
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coeff: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -76,17 +82,32 @@ class LlamaConfig:
 
 def _layer_shapes(cfg: LlamaConfig) -> Dict[str, Tuple[int, ...]]:
     hd = cfg.head_dim
-    return {
+    shapes = {
         "attn_norm": (cfg.dim,),
         "wq": (cfg.dim, cfg.n_heads, hd),
         "wk": (cfg.dim, cfg.n_kv_heads, hd),
         "wv": (cfg.dim, cfg.n_kv_heads, hd),
         "wo": (cfg.n_heads, hd, cfg.dim),
         "mlp_norm": (cfg.dim,),
-        "w_gate": (cfg.dim, cfg.mlp_hidden),
-        "w_up": (cfg.dim, cfg.mlp_hidden),
-        "w_down": (cfg.mlp_hidden, cfg.dim),
     }
+    if cfg.moe_experts > 0:
+        shapes.update(
+            {
+                "router": (cfg.dim, cfg.moe_experts),
+                "w_gate": (cfg.moe_experts, cfg.dim, cfg.mlp_hidden),
+                "w_up": (cfg.moe_experts, cfg.dim, cfg.mlp_hidden),
+                "w_down": (cfg.moe_experts, cfg.mlp_hidden, cfg.dim),
+            }
+        )
+    else:
+        shapes.update(
+            {
+                "w_gate": (cfg.dim, cfg.mlp_hidden),
+                "w_up": (cfg.dim, cfg.mlp_hidden),
+                "w_down": (cfg.mlp_hidden, cfg.dim),
+            }
+        )
+    return shapes
 
 
 def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
@@ -98,10 +119,19 @@ def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
         "wv": ("embed", "kv_heads", "head_dim"),
         "wo": ("heads", "head_dim", "embed"),
         "mlp_norm": (None,),
-        "w_gate": ("embed", "mlp"),
-        "w_up": ("embed", "mlp"),
-        "w_down": ("mlp", "embed"),
     }
+    if cfg.moe_experts > 0:
+        from ray_tpu.ops.moe import moe_logical_axes
+
+        layer.update(moe_logical_axes())
+    else:
+        layer.update(
+            {
+                "w_gate": ("embed", "mlp"),
+                "w_up": ("embed", "mlp"),
+                "w_down": ("mlp", "embed"),
+            }
+        )
     return {
         "embed": ("vocab", "embed"),
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
@@ -117,13 +147,22 @@ def init_params(cfg: LlamaConfig, rng: jax.Array) -> Dict[str, Any]:
         scale = 1.0 / math.sqrt(fan_in)
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
 
+    _MOE_PARAMS = ("router", "w_gate", "w_up", "w_down")
+
     def layer(key):
         shapes = _layer_shapes(cfg)
         ks = jax.random.split(key, len(shapes))
         out = {}
+        moe = cfg.moe_experts > 0
         for (name, shape), k in zip(shapes.items(), ks):
             if name.endswith("norm"):
                 out[name] = jnp.ones(shape, cfg.dtype)
+            elif moe and name == "router":
+                # routing logits are precision-sensitive: keep f32
+                out[name] = jax.random.normal(k, shape, jnp.float32) / math.sqrt(shape[0])
+            elif moe and name in _MOE_PARAMS:
+                # (E, fan_in, fan_out): contraction dim is shape[-2]
+                out[name] = dense(k, shape, shape[-2])
             else:
                 out[name] = dense(k, shape, shape[0] if len(shape) == 2 else cfg.dim)
         return out
@@ -220,38 +259,58 @@ def _attention_block(cfg: LlamaConfig, p, x, cos, sin, mesh=None):
 
 
 def _mlp_block(cfg: LlamaConfig, p, x):
+    """Dense or MoE FFN. Returns (x, aux_loss)."""
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    if cfg.moe_experts > 0:
+        from ray_tpu.ops.moe import moe_ffn
+
+        out, aux = moe_ffn(
+            {k: p[k] for k in ("router", "w_gate", "w_up", "w_down")},
+            h,
+            top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+        )
+        return x + out, aux["aux_loss"]
     gate = jnp.einsum("bsd,dm->bsm", h, p["w_gate"])
     up = jnp.einsum("bsd,dm->bsm", h, p["w_up"])
-    return x + jnp.einsum("bsm,md->bsd", jax.nn.silu(gate) * up, p["w_down"])
+    return x + jnp.einsum("bsm,md->bsd", jax.nn.silu(gate) * up, p["w_down"]), 0.0
 
 
-def forward(cfg: LlamaConfig, params, tokens, *, remat: bool = False, mesh=None):
+def forward(cfg: LlamaConfig, params, tokens, *, remat: bool = False, mesh=None,
+            return_aux: bool = False):
     """tokens [B, S] int32 → logits [B, S, vocab] (f32).
 
     ``mesh`` is required for the sequence-parallel attention impls
-    ("ring"/"ulysses"), which shard_map over its ``seq`` axis."""
+    ("ring"/"ulysses"), which shard_map over its ``seq`` axis. With
+    ``return_aux`` also returns the summed MoE load-balance loss."""
     B, S = tokens.shape
     x = params["embed"][tokens]
     cos, sin = rope_tables(cfg, S)
 
-    def block(x, p):
+    def block(carry, p):
+        x, aux = carry
         x = _attention_block(cfg, p, x, cos, sin, mesh=mesh)
-        return _mlp_block(cfg, p, x)
+        x, layer_aux = _mlp_block(cfg, p, x)
+        return x, aux + layer_aux
 
     if remat:
         block = jax.checkpoint(block)
+    carry = (x, jnp.zeros((), jnp.float32))
     for p in params["layers"]:
-        x = block(x, p)
+        carry = block(carry, p)
+    x, aux = carry
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    if return_aux:
+        return logits, aux
+    return logits
 
 
 def next_token_loss(cfg: LlamaConfig, params, tokens, targets, *, remat: bool = False, mesh=None):
-    logits = forward(cfg, params, tokens, remat=remat, mesh=mesh)
+    logits, aux = forward(cfg, params, tokens, remat=remat, mesh=mesh, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)
-    return nll.mean()
+    return nll.mean() + cfg.moe_aux_loss_coeff * aux
 
 
 # ---------------------------------------------------------------------------

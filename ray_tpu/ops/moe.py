@@ -1,0 +1,117 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+Reference: absent (SURVEY §2.4 — EP is a build-new item). Design is the
+GSPMD dense-dispatch recipe (Switch/GShard): top-k routing produces a
+capacity-limited one-hot dispatch tensor; dispatch/combine are einsums,
+expert FFNs run batched over the expert dim, and sharding the expert
+dim over the ``expert`` mesh axis makes XLA insert the all-to-alls over
+ICI — no hand-written collectives (scaling-book recipe).
+
+Capacity semantics: each expert processes at most
+``capacity = ceil(tokens/experts * capacity_factor)`` tokens; overflow
+tokens pass through unchanged (their combine weight is zero) — the
+standard Switch Transformer drop policy."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_moe_params(
+    rng: jax.Array,
+    dim: int,
+    hidden: int,
+    num_experts: int,
+    dtype=jnp.float32,
+) -> Dict[str, Any]:
+    """Router + per-expert SwiGLU FFN params (stacked over experts)."""
+    kr, kg, ku, kd = jax.random.split(rng, 4)
+    scale_in = 1.0 / math.sqrt(dim)
+    scale_hid = 1.0 / math.sqrt(hidden)
+    return {
+        "router": (jax.random.normal(kr, (dim, num_experts), jnp.float32) * scale_in),
+        "w_gate": (jax.random.normal(kg, (num_experts, dim, hidden), jnp.float32) * scale_in).astype(dtype),
+        "w_up": (jax.random.normal(ku, (num_experts, dim, hidden), jnp.float32) * scale_in).astype(dtype),
+        "w_down": (jax.random.normal(kd, (num_experts, hidden, dim), jnp.float32) * scale_hid).astype(dtype),
+    }
+
+
+def moe_logical_axes() -> Dict[str, Tuple[Optional[str], ...]]:
+    """Logical axes for the params above (rules map "expert"→EXPERT mesh
+    axis so expert FFNs shard with all-to-all dispatch inserted by XLA)."""
+    return {
+        "router": (None, None),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+
+
+def moe_ffn(
+    params: Dict[str, Any],
+    x: jnp.ndarray,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    router_noise: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """x: [B, S, d] → (out [B, S, d], aux dict with load-balance loss).
+
+    Dense dispatch: one-hot [T, E, C] tensors route tokens to expert
+    slots; dropped (over-capacity) tokens contribute zero and fall back
+    to the residual stream."""
+    B, S, d = x.shape
+    E = params["router"].shape[1]
+    T = B * S
+    # GShard capacity: expected per-expert load is top_k*T/E assignments
+    # under balanced routing — omitting top_k would silently drop
+    # ~(1 - cf/top_k) of dispatches from step 0
+    capacity = max(1, int(math.ceil(top_k * T / E * capacity_factor)))
+
+    xt = x.reshape(T, d)
+    logits = (xt.astype(jnp.float32) @ params["router"])  # [T, E]
+    if router_noise > 0.0 and rng is not None:
+        logits = logits + router_noise * jax.random.normal(rng, logits.shape)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-k expert choices per token
+    gate_vals, expert_idx = jax.lax.top_k(probs, top_k)  # [T, k]
+    # renormalize the kept gates
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # per-(token, choice) slot position within the chosen expert, by
+    # arrival order: cumsum of one-hot over the flattened (T*k) axis
+    choice_onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # [T, k, E]
+    flat_choice = choice_onehot.reshape(T * top_k, E)
+    positions = jnp.cumsum(flat_choice, axis=0) - flat_choice  # slots before me
+    slot = (positions * flat_choice).sum(-1).reshape(T, top_k)  # [T, k]
+    kept = slot < capacity
+
+    gate_vals = gate_vals * kept.astype(gate_vals.dtype)
+
+    # dispatch [T, E, C] (bool) and combine [T, E, C] (weighted)
+    slot_onehot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # [T, k, C]
+    disp = jnp.einsum("tke,tkc->tec", choice_onehot.astype(jnp.float32), slot_onehot)
+    combine = jnp.einsum("tk,tke,tkc->tec", gate_vals, choice_onehot.astype(jnp.float32), slot_onehot)
+
+    # route tokens to expert slots: [E, C, d]
+    expert_in = jnp.einsum("tec,td->ecd", disp, xt.astype(jnp.float32)).astype(x.dtype)
+
+    # expert FFN batched over E (sharded over the expert mesh axis)
+    h_gate = jnp.einsum("ecd,edh->ech", expert_in, params["w_gate"])
+    h_up = jnp.einsum("ecd,edh->ech", expert_in, params["w_up"])
+    expert_out = jnp.einsum("ech,ehd->ecd", jax.nn.silu(h_gate) * h_up, params["w_down"])
+
+    out = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+    out = out.reshape(B, S, d).astype(x.dtype)
+
+    # Switch load-balance aux loss: E * sum_e f_e * p_e
+    me = probs.mean(axis=0)  # mean router prob per expert
+    ce = choice_onehot[:, 0, :].astype(jnp.float32).mean(axis=0)  # top-1 fraction
+    aux_loss = E * jnp.sum(me * ce)
+    return out, {"aux_loss": aux_loss, "dropped_fraction": 1.0 - kept.astype(jnp.float32).mean()}

@@ -7,6 +7,7 @@ placement and coordination. Cross-host/DCN data movement rides the object
 store.
 """
 
+from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from ray_tpu.parallel.mesh import (
     MeshSpec,
     DATA,
@@ -26,6 +27,8 @@ from ray_tpu.parallel.sharding import (
 from ray_tpu.parallel.collectives import CollectiveGroup, ObjectStoreCollectives
 
 __all__ = [
+    "pipeline_apply",
+    "stack_stage_params",
     "MeshSpec",
     "DATA",
     "FSDP",
