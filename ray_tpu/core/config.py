@@ -73,6 +73,9 @@ class GlobalConfig:
     log_to_driver: bool = True
     #: push task lifecycle events to the controller (state API `list tasks`)
     task_events_enabled: bool = True
+    #: grace window for daemons to re-register/sync after a controller
+    #: restart before unadopted restored state is rescheduled
+    controller_restore_grace_s: float = 10.0
 
     # --- RPC ---
     rpc_connect_timeout_s: float = 10.0

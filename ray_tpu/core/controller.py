@@ -66,6 +66,8 @@ class ActorInfo:
     num_restarts: int = 0
     death_reason: str = ""
     pid: int = 0
+    #: True only for snapshot-restored actors awaiting daemon adoption
+    restored: bool = False
 
 
 @dataclass
@@ -79,7 +81,11 @@ class PgInfo:
 
 
 class Controller:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
+        #: optional snapshot file: tables survive a controller restart
+        #: (reference: GCS rebuilds from Redis, ``gcs_init_data.cc``)
+        self.persist_path = persist_path
         self.server = RpcServer(host, port)
         self.nodes: Dict[bytes, NodeInfo] = {}
         self.node_clients: Dict[bytes, RpcClient] = {}
@@ -103,16 +109,167 @@ class Controller:
         self._channel_subs: Dict[int, Set[ServerConnection]] = {}
         self._metrics_server = None
         self._health_task: Optional[asyncio.Task] = None
+        self._persist_task: Optional[asyncio.Task] = None
+        self._mutations = 0  # bumped on persisted-table changes
         self._stopping = False
         for name in [m for m in dir(self) if m.startswith("c_")]:
             self.server.register(name[2:], getattr(self, name))
         self.server.on_disconnect = self._on_disconnect
 
     async def start(self) -> int:
-        port = await self.server.start()
+        restored_port = self._load_snapshot()
+        if restored_port and self.server.port == 0:
+            # a restarted controller rebinds its old port so daemons'
+            # existing retry loops can reconnect without rediscovery
+            self.server.port = restored_port
+        try:
+            port = await self.server.start()
+        except OSError:
+            # old port still held (e.g. lingering socket): fall back
+            self.server.port = 0
+            port = await self.server.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
+        if self.persist_path:
+            self._persist_task = asyncio.ensure_future(self._persist_loop())
         self._start_metrics()
         return port
+
+    # ---- persistence (GCS restart recovery) ----------------------------
+    def _snapshot(self) -> Dict[str, Any]:
+        return {
+            "port": getattr(self.server, "port", 0),
+            "kv": dict(self.kv),
+            "jobs": dict(self.jobs),
+            "named_actors": dict(self.named_actors),
+            "named_pgs": dict(self.named_pgs),
+            "pgs": {
+                pg_id: {
+                    "bundles": info.bundles,
+                    "strategy": info.strategy,
+                    "name": info.name,
+                }
+                for pg_id, info in self.pgs.items()
+            },
+            "actors": {
+                actor_id: {
+                    "spec": info.spec,
+                    "num_restarts": info.num_restarts,
+                }
+                for actor_id, info in self.actors.items()
+                if info.state != "DEAD"
+            },
+        }
+
+    def _mark_dirty(self) -> None:
+        self._mutations += 1
+
+    def _write_snapshot(self) -> None:
+        """Atomic snapshot write (tmp + rename) shared by the loop and
+        clean shutdown — a crash mid-write must never clobber the last
+        good snapshot."""
+        import os as _os
+        import pickle as _pickle
+
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "wb") as f:
+            _pickle.dump(self._snapshot(), f)
+        _os.replace(tmp, self.persist_path)
+
+    async def _persist_loop(self) -> None:
+        persisted = -1
+        while not self._stopping:
+            await asyncio.sleep(1.0)
+            if self._mutations == persisted:
+                continue  # nothing changed: skip the pickle+write churn
+            try:
+                persisted = self._mutations
+                self._write_snapshot()
+            except Exception:
+                logger.exception("controller snapshot failed")
+
+    def _load_snapshot(self) -> Optional[int]:
+        """Restart recovery: restore KV/jobs/PGs/actors from the snapshot.
+        PGs re-run 2PC (daemon prepare/commit are idempotent, so bundles
+        still held by live daemons are simply re-adopted); actors come
+        back RESTARTING and are adopted ALIVE when their daemon's next
+        sync reports them running — see ``c_sync_resources``."""
+        if not self.persist_path:
+            return None
+        import os as _os
+        import pickle as _pickle
+
+        if not _os.path.exists(self.persist_path):
+            return None
+        try:
+            with open(self.persist_path, "rb") as f:
+                snap = _pickle.load(f)
+        except Exception:
+            logger.exception("controller snapshot load failed")
+            return None
+        self.kv.update(snap.get("kv", {}))
+        self.jobs.update(snap.get("jobs", {}))
+        self.named_actors.update(snap.get("named_actors", {}))
+        self.named_pgs.update(snap.get("named_pgs", {}))
+        for pg_id, p in snap.get("pgs", {}).items():
+            info = PgInfo(
+                pg_id=pg_id, bundles=p["bundles"], strategy=p["strategy"],
+                name=p["name"], state="RESTORING",
+            )
+            self.pgs[pg_id] = info
+        for actor_id, a in snap.get("actors", {}).items():
+            self.actors[actor_id] = ActorInfo(
+                spec=a["spec"],
+                state="RESTARTING",
+                num_restarts=a["num_restarts"],
+                restored=True,
+            )
+        if snap.get("actors") or snap.get("pgs"):
+            asyncio.ensure_future(self._reconcile_restored_state())
+        logger.info(
+            "controller restored %d kv keys, %d pgs, %d actors from snapshot",
+            len(snap.get("kv", {})), len(snap.get("pgs", {})), len(snap.get("actors", {})),
+        )
+        return snap.get("port") or None
+
+    async def _reconcile_restored_state(self) -> None:
+        """After a grace window for daemons to re-register/sync: restored
+        PGs whose bundles weren't fully re-adopted are released and
+        rescheduled; restored actors not adopted are re-scheduled FRESH
+        (no restart budget consumed — the controller dying is not the
+        actor's failure). A daemon partitioned longer than the grace
+        window can still yield a duplicate actor; the reference carries
+        the same trade-off in its raylet-reconnect window."""
+        await asyncio.sleep(GLOBAL_CONFIG.controller_restore_grace_s)
+        for pg_id, info in list(self.pgs.items()):
+            if info.state != "RESTORING":
+                continue
+            if len(info.reservations) == len(info.bundles):
+                info.state = "CREATED"
+                await self._publish(PG_PUSH_CHANNEL, {"pg_id": pg_id, "state": "CREATED"})
+                continue
+            # partial/no adoption: release what was adopted, reschedule
+            for res in info.reservations:
+                client = self.node_clients.get(res.node_id)
+                if client is not None:
+                    try:
+                        await client.call(
+                            "release_bundle",
+                            {"pg_id": pg_id, "bundle_index": res.bundle_index},
+                            timeout=10,
+                        )
+                    except Exception:
+                        pass
+            info.reservations = []
+            info.state = "PENDING"
+            asyncio.ensure_future(self._schedule_pg(pg_id))
+        for actor_id, info in list(self.actors.items()):
+            if info.restored and info.state == "RESTARTING" and info.address is None:
+                info.restored = False
+                logger.info(
+                    "restored actor %s not adopted; rescheduling fresh",
+                    actor_id.hex()[:8],
+                )
+                asyncio.ensure_future(self._schedule_actor(actor_id))
 
     def _start_metrics(self) -> None:
         if not GLOBAL_CONFIG.metrics_export_enabled:
@@ -151,6 +308,14 @@ class Controller:
 
     async def stop(self) -> None:
         self._stopping = True
+        if self._persist_task is not None:
+            self._persist_task.cancel()
+            # final consistent snapshot on clean shutdown (atomic write:
+            # a kill mid-dump must not truncate the last good snapshot)
+            try:
+                self._write_snapshot()
+            except Exception:
+                pass
         if self._metrics_server is not None:
             from ray_tpu.observability.metrics import remove_collect
 
@@ -210,6 +375,19 @@ class Controller:
         )
         self.nodes[info.node_id] = info
         self.node_clients[info.node_id] = RpcClient(info.host, info.port, name="noded")
+        # Re-adoption: a (re)registering daemon reports the PG bundles it
+        # still holds; a restarted controller reattaches them to RESTORING
+        # PGs instead of double-reserving elsewhere.
+        for b in payload.get("bundles", []):
+            pg = self.pgs.get(b["pg_id"])
+            if pg is not None and pg.state == "RESTORING":
+                pg.reservations.append(
+                    BundleReservation(
+                        node_id=info.node_id,
+                        bundle_index=b["bundle_index"],
+                        resources=b["resources"],
+                    )
+                )
         logger.info("node %s registered (%s)", info.node_id.hex()[:8], info.total)
         await self._publish(NODE_PUSH_CHANNEL, {"node_id": info.node_id, "alive": True})
         return {"ok": True}
@@ -218,11 +396,36 @@ class Controller:
         """Daemon heartbeat: report availability, receive the cluster view
         (the ray_syncer exchange)."""
         node = self.nodes.get(payload["node_id"])
-        if node is not None:
-            node.available = payload["available"]
-            node.total = payload.get("total", node.total)
-            node.last_sync = time.monotonic()
-            node.health_failures = 0
+        if node is None:
+            # restarted controller: this daemon predates us — ask it to
+            # re-register (carrying its held bundles for re-adoption)
+            return {"unknown_node": True, "view": []}
+        node.available = payload["available"]
+        node.total = payload.get("total", node.total)
+        node.last_sync = time.monotonic()
+        node.health_failures = 0
+        # adopt running actors a restored controller only knows as
+        # RESTARTING-from-snapshot (restart recovery reconciliation)
+        for a in payload.get("actors", []):
+            info = self.actors.get(a["actor_id"])
+            if (
+                info is not None
+                and info.restored
+                and info.state == "RESTARTING"
+                and info.address is None
+            ):
+                info.restored = False
+                info.state = "ALIVE"
+                info.address = Address(
+                    worker_id=b"", node_id=payload["node_id"],
+                    host=a["host"], port=a["port"],
+                )
+                info.node_id = payload["node_id"]
+                info.pid = a["pid"]
+                await self._publish(
+                    ACTOR_PUSH_CHANNEL,
+                    {"actor_id": a["actor_id"], "state": "ALIVE", "address": info.address},
+                )
         return {
             "view": [
                 {

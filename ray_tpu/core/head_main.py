@@ -24,7 +24,11 @@ async def amain(args) -> None:
 
     if args.system_config:
         GLOBAL_CONFIG.apply_system_config(json.loads(args.system_config))
-    controller = Controller()
+    persist = None
+    if args.session_dir:
+        os.makedirs(args.session_dir, exist_ok=True)
+        persist = os.path.join(args.session_dir, "controller_snapshot.pkl")
+    controller = Controller(persist_path=persist)
     cport = await controller.start()
     resources = json.loads(args.resources) if args.resources else {}
     if args.num_cpus is not None:

@@ -64,6 +64,7 @@ class WorkerProc:
     tpu_chips: Optional[List[int]] = None  # chip ids assigned to this worker
     conn: Optional[ServerConnection] = None
     client: Optional[RpcClient] = None
+    idle_since: float = 0.0  # monotonic ts when last parked in the idle pool
 
 
 @dataclass
@@ -145,6 +146,17 @@ class NodeDaemon:
         port = await self.server.start()
         self.port = port
         self._start_metrics()
+        await self._register_with_controller(port)
+        self._tasks.append(asyncio.ensure_future(self._sync_loop()))
+        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        self._tasks.append(asyncio.ensure_future(self._log_tail_loop()))
+        # Prestart (reference WorkerPool prestart): warm the pool so the
+        # first wave of leases skips cold-start latency.
+        for _ in range(GLOBAL_CONFIG.num_initial_workers):
+            self._spawn_worker()
+        return port
+
+    async def _register_with_controller(self, port: int) -> None:
         await self.controller.call(
             "register_node",
             {
@@ -153,13 +165,19 @@ class NodeDaemon:
                 "port": port,
                 "resources": self.resources.total.to_dict(),
                 "labels": self.resources.labels,
+                # held PG bundles: a restarted controller re-adopts these
+                # instead of double-reserving the PG elsewhere
+                "bundles": [
+                    {
+                        "pg_id": key[0],
+                        "bundle_index": key[1],
+                        "resources": pool.total.to_dict(),
+                    }
+                    for key, pool in self._bundle_pools.items()
+                ],
             },
             retries=GLOBAL_CONFIG.rpc_max_retries,
         )
-        self._tasks.append(asyncio.ensure_future(self._sync_loop()))
-        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
-        self._tasks.append(asyncio.ensure_future(self._log_tail_loop()))
-        return port
 
     def _start_metrics(self) -> None:
         """Prometheus /metrics endpoint (reference ``metrics_agent.py`` →
@@ -290,9 +308,28 @@ class NodeDaemon:
                         "node_id": self.node_id.binary(),
                         "available": self.resources.available.to_dict(),
                         "total": self.resources.total.to_dict(),
+                        # running actors: a restarted controller adopts
+                        # these instead of re-scheduling them (GCS-restart
+                        # reconciliation, reference raylet reconnect)
+                        "actors": [
+                            {
+                                "actor_id": w.actor_id,
+                                "host": w.host,
+                                "port": w.port,
+                                "pid": w.pid,
+                            }
+                            for w in self.workers.values()
+                            if w.actor_id is not None and w.registered
+                        ],
                     },
                     timeout=5,
                 )
+                if reply.get("unknown_node"):
+                    # controller restarted and lost node membership:
+                    # re-register, carrying held bundles for re-adoption
+                    logger.info("controller does not know us — re-registering")
+                    await self._register_with_controller(self.port)
+                    continue
                 self._view = [
                     _ViewNode(
                         node_id=n["node_id"],
@@ -384,6 +421,7 @@ class NodeDaemon:
             # Workers spawned by a waiting _pop_worker are claimed by that
             # lease — adding them to the idle pool too would double-grant
             # one worker to two leases (deadlock on its execution lane).
+            w.idle_since = time.monotonic()
             self.idle.append(w)
             self._notify_capacity()
         return {"node_id": self.node_id.binary()}
@@ -427,7 +465,28 @@ class NodeDaemon:
                         )
                     except Exception:
                         pass
+            self._kill_idle_workers()
             await asyncio.sleep(0.1)
+
+    def _kill_idle_workers(self) -> None:
+        """Reference ``idle_worker_killing``: pooled workers idle past the
+        deadline are retired (the floor of ``num_initial_workers`` stays
+        warm)."""
+        deadline = GLOBAL_CONFIG.idle_worker_killing_time_s
+        if deadline <= 0:
+            return
+        now = time.monotonic()
+        keep_floor = GLOBAL_CONFIG.num_initial_workers
+        for w in list(self.idle):
+            if len(self.idle) <= keep_floor:
+                break
+            if w.claimed or now - w.idle_since < deadline:
+                continue
+            self.idle.remove(w)
+            try:
+                w.proc.terminate()  # reap loop finishes the bookkeeping
+            except Exception:
+                pass
 
     # ---- leases (task scheduling) -------------------------------------
     async def d_request_lease(self, payload, conn):
@@ -518,6 +577,7 @@ class NodeDaemon:
                 self._free_tpu_chips(chips)
                 worker.leased = False
                 if worker not in self.idle:
+                    worker.idle_since = time.monotonic()
                     self.idle.append(worker)
                 if bundle_key is not None:
                     self._bundle_pools[bundle_key].release(ResourceSet(request))
@@ -591,6 +651,7 @@ class NodeDaemon:
         # it to the idle pool so it isn't orphaned
         w.claimed = False
         if w.registered and not w.leased and w not in self.idle:
+            w.idle_since = time.monotonic()
             self.idle.append(w)
         return None
 
@@ -622,6 +683,7 @@ class NodeDaemon:
                 pass
             return
         if w.proc.poll() is None and w.registered and w.actor_id is None and w not in self.idle:
+            w.idle_since = time.monotonic()
             self.idle.append(w)
 
     # ---- actors --------------------------------------------------------

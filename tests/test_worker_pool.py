@@ -1,0 +1,49 @@
+"""Worker pool lifecycle: idle killing + prestart (reference
+``worker_pool.h`` idle-worker reaping / prestart)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+def test_idle_worker_killing_and_prestart():
+    """The idle_worker_killing_time_s / num_initial_workers flags are
+    live: pooled workers above the floor are retired after idling."""
+    import time as _t
+
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    old_kill = GLOBAL_CONFIG.idle_worker_killing_time_s
+    old_init = GLOBAL_CONFIG.num_initial_workers
+    GLOBAL_CONFIG.idle_worker_killing_time_s = 1.0
+    GLOBAL_CONFIG.num_initial_workers = 1
+    try:
+        ray_tpu.shutdown()  # a prior test in this module may have left a cluster up
+        ray_tpu.init(num_cpus=4)
+
+        @ray_tpu.remote
+        def noop():
+            return 1
+
+        # spin up several pooled workers
+        assert ray_tpu.get([noop.remote() for _ in range(8)], timeout=120) == [1] * 8
+        from ray_tpu.core.api import _global_worker
+
+        core = _global_worker().backend
+        stats = core.io.run(core.daemon.call("stats"))
+        assert stats["num_workers"] >= 2
+        deadline = _t.time() + 30
+        while _t.time() < deadline:
+            stats = core.io.run(core.daemon.call("stats"))
+            # retired down to the warm floor (1) + any dedicated workers
+            if stats["num_idle"] <= 1:
+                break
+            _t.sleep(0.5)
+        assert stats["num_idle"] <= 1, stats
+        # the floor worker still serves tasks
+        assert ray_tpu.get(noop.remote(), timeout=60) == 1
+    finally:
+        GLOBAL_CONFIG.idle_worker_killing_time_s = old_kill
+        GLOBAL_CONFIG.num_initial_workers = old_init
+        ray_tpu.shutdown()
