@@ -77,6 +77,13 @@ class GlobalConfig:
     #: restart before unadopted restored state is rescheduled
     controller_restore_grace_s: float = 10.0
 
+    # --- memory monitor (``common/memory_monitor.h:52``) ---
+    memory_monitor_enabled: bool = True
+    #: kill the newest leased task worker when the node's available
+    #: memory falls below this fraction (owners resubmit per max_retries)
+    memory_monitor_min_available_fraction: float = 0.03
+    memory_monitor_period_s: float = 1.0
+
     # --- RPC ---
     rpc_connect_timeout_s: float = 10.0
     rpc_retry_base_delay_s: float = 0.05

@@ -137,6 +137,7 @@ class NodeDaemon:
         self._peer_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._tasks: List[asyncio.Task] = []
         self._capacity_event = asyncio.Event()
+        self._last_oom_check = 0.0
         self._stopping = False
         for name in [m for m in dir(self) if m.startswith("d_")]:
             self.server.register(name[2:], getattr(self, name))
@@ -155,6 +156,56 @@ class NodeDaemon:
         for _ in range(GLOBAL_CONFIG.num_initial_workers):
             self._spawn_worker()
         return port
+
+    # ---- memory monitor (OOM killer) -----------------------------------
+    @staticmethod
+    def _memory_available_fraction() -> float:
+        """MemAvailable/MemTotal from /proc/meminfo (no psutil dep)."""
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, rest = line.partition(":")
+                    info[k] = int(rest.strip().split()[0])
+            return info["MemAvailable"] / max(1, info["MemTotal"])
+        except Exception:
+            return 1.0  # unknown platform: never trigger
+
+    def _oom_check(self, available_fraction: Optional[float] = None) -> Optional[WorkerProc]:
+        """Reference ``MemoryMonitor`` + ``WorkerKillingPolicy``: when the
+        node runs out of memory, kill the NEWEST leased pooled worker
+        (newest-first loses the least progress; reference FIFO policy).
+        The owner resubmits the task if it has retries left — a task
+        submitted with max_retries=0 fails as WorkerCrashedError, the
+        same contract as any worker death. Returns the victim (already
+        terminated) or None."""
+        if not GLOBAL_CONFIG.memory_monitor_enabled:
+            return None
+        frac = (
+            available_fraction
+            if available_fraction is not None
+            else self._memory_available_fraction()
+        )
+        if frac >= GLOBAL_CONFIG.memory_monitor_min_available_fraction:
+            return None
+        leased = [
+            l.worker
+            for l in sorted(self.leases.values(), key=lambda l: l.lease_id)
+            if l.worker.actor_id is None
+        ]
+        if not leased:
+            return None
+        victim = leased[-1]  # newest lease = least progress lost
+        logger.warning(
+            "memory monitor: available fraction %.3f below %.3f — killing "
+            "newest task worker pid=%d",
+            frac, GLOBAL_CONFIG.memory_monitor_min_available_fraction, victim.pid,
+        )
+        try:
+            victim.proc.kill()
+        except Exception:
+            pass
+        return victim
 
     async def _register_with_controller(self, port: int) -> None:
         await self.controller.call(
@@ -466,6 +517,10 @@ class NodeDaemon:
                     except Exception:
                         pass
             self._kill_idle_workers()
+            now = time.monotonic()
+            if now - self._last_oom_check >= GLOBAL_CONFIG.memory_monitor_period_s:
+                self._last_oom_check = now
+                self._oom_check()
             await asyncio.sleep(0.1)
 
     def _kill_idle_workers(self) -> None:

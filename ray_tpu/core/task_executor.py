@@ -86,6 +86,8 @@ class TaskExecutor:
         def _create():
             self.api_worker.job_id = spec.job_id
             self.api_worker.set_task_context(spec.task_id, spec.job_id)
+            # dedicated worker: runtime-env vars apply for its lifetime
+            self._apply_runtime_env(spec)
             cls = self.api_worker.fn_table.load(spec.function_id)
             args, kwargs = execution.resolve_args(spec, self._get_dep)
             self._actor_instance = cls(*args, **kwargs)
@@ -157,6 +159,7 @@ class TaskExecutor:
             err = TaskError(spec.name, AttributeError(f"no method {spec.method_name!r}"))
             return {"results": [(oid.binary(), "error", pickle.dumps(err)) for oid in spec.return_ids]}
         if inspect.iscoroutinefunction(method):
+            self._apply_runtime_env(spec)  # dedicated actor worker: permanent
             return await self._run_async_method(spec, method)
         caller = spec.owner.worker_id if spec.owner else b""
         if self._max_concurrency == 1 and not spec.concurrency_group:
@@ -249,6 +252,46 @@ class TaskExecutor:
                 args={"task_id": spec.task_id.hex()[:16]},
             )
 
+    def _apply_runtime_env(self, spec: TaskSpec):
+        """Minimal runtime-env support (reference
+        ``_private/runtime_env/``): ``env_vars`` apply for the task's
+        duration on pooled workers (restored afterwards — the pool is
+        shared) and permanently on dedicated actor workers. Returns a
+        restore callable or None.
+
+        The restore is generation-guarded: a cancelled task's thread can
+        overlap the next task briefly (retired-lane window), and a stale
+        restore must not clobber the newer task's environment. Nested
+        overlap can still leave the older values applied — the reference
+        avoids this class of problem entirely by dedicating workers per
+        runtime env, which is the upgrade path here too."""
+        env = spec.runtime_env or {}
+        env_vars = env.get("env_vars")
+        if not env_vars:
+            return None
+        if not isinstance(env_vars, dict):
+            raise ValueError(f"env_vars must be a dict, got {type(env_vars).__name__}")
+        import os
+
+        if spec.kind != TaskKind.NORMAL or spec.actor_id is not None:
+            os.environ.update({k: str(v) for k, v in env_vars.items()})
+            return None
+        self._env_gen = getattr(self, "_env_gen", 0) + 1
+        my_gen = self._env_gen
+        saved = {k: os.environ.get(k) for k in env_vars}
+        os.environ.update({k: str(v) for k, v in env_vars.items()})
+
+        def restore():
+            if self._env_gen != my_gen:
+                return  # a newer task re-applied env vars: don't clobber
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+
+        return restore
+
     def cancel_task(self, task_id: bytes, force: bool) -> bool:
         """Cooperative (or forced) cancellation (``CoreWorker::CancelTask``).
 
@@ -312,7 +355,14 @@ class TaskExecutor:
                 self._running_threads[tid] = threading.get_ident()
         if spec.kind != TaskKind.ACTOR_TASK:
             self.core.emit_task_event(spec, "RUNNING")
+        env_restore = None
         try:
+            try:
+                env_restore = self._apply_runtime_env(spec)
+            except Exception as e:  # noqa: BLE001 — malformed runtime_env
+                return error_results(
+                    TaskError(spec.name, ValueError(f"bad runtime_env: {e!r}"))
+                )
             try:
                 if spec.kind == TaskKind.ACTOR_TASK:
                     fn = getattr(self._actor_instance, spec.method_name)
@@ -330,6 +380,9 @@ class TaskExecutor:
         finally:
             with self._cancel_lock:
                 self._running_threads.pop(tid, None)
+            if env_restore is not None:
+                env_restore()
+        # (env restore is generation-guarded: see _apply_runtime_env)
         # An async-raised TaskCancelledError lands as the TaskError cause:
         # surface it as the cancellation itself, not an app failure.
         out: List[Tuple[ObjectID, Any]] = []

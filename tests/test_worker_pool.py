@@ -47,3 +47,55 @@ def test_idle_worker_killing_and_prestart():
         GLOBAL_CONFIG.idle_worker_killing_time_s = old_kill
         GLOBAL_CONFIG.num_initial_workers = old_init
         ray_tpu.shutdown()
+
+
+def test_oom_killer_picks_newest_leased_worker():
+    """Memory-monitor policy (reference WorkerKillingPolicy): under
+    memory pressure the NEWEST leased task worker dies; actors and idle
+    workers are spared. Uses an injected availability reading."""
+    import asyncio
+    import time as _t
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    try:
+
+        @ray_tpu.remote(num_cpus=1, max_retries=2)
+        def hold(tag):
+            _t.sleep(6)
+            return tag
+
+        refs = [hold.remote(i) for i in range(2)]
+        _t.sleep(2.0)  # both leased and running
+
+        # reach into the head daemon (in-process would be cleaner, but the
+        # daemon runs in the head subprocess) — drive the policy via the
+        # same code path on a locally-constructed state instead:
+        from ray_tpu.core.node_daemon import Lease, NodeDaemon, WorkerProc
+
+        class FakeProc:
+            def __init__(self):
+                self.killed = False
+            def kill(self):
+                self.killed = True
+            def poll(self):
+                return None
+
+        d = NodeDaemon.__new__(NodeDaemon)  # policy-only instance
+        d.leases = {}
+        w1, w2 = WorkerProc(1, FakeProc(), "a"), WorkerProc(2, FakeProc(), "b")
+        actor_w = WorkerProc(3, FakeProc(), "c")
+        actor_w.actor_id = object()
+        d.leases[1] = Lease(1, {"CPU": 1}, w1)
+        d.leases[2] = Lease(2, {"CPU": 1}, w2)
+        d.leases[3] = Lease(3, {"CPU": 1}, actor_w)
+
+        assert d._oom_check(available_fraction=0.5) is None  # healthy
+        victim = d._oom_check(available_fraction=0.001)
+        assert victim is w2  # newest non-actor lease
+        assert w2.proc.killed and not w1.proc.killed and not actor_w.proc.killed
+
+        # the real cluster's tasks still complete (retries cover any kill)
+        assert ray_tpu.get(refs, timeout=120) == [0, 1]
+    finally:
+        ray_tpu.shutdown()
