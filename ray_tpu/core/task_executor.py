@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import logging
+import os
 import pickle
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -47,6 +48,7 @@ class TaskExecutor:
         self._cancelled: set = set()
         self._running_threads: Dict[bytes, int] = {}
         self._cancel_lock = threading.Lock()
+        self._env_gen = 0  # runtime-env application generation
 
     def bind(self, core, api_worker) -> None:
         self.core = core
@@ -159,7 +161,16 @@ class TaskExecutor:
             err = TaskError(spec.name, AttributeError(f"no method {spec.method_name!r}"))
             return {"results": [(oid.binary(), "error", pickle.dumps(err)) for oid in spec.return_ids]}
         if inspect.iscoroutinefunction(method):
-            self._apply_runtime_env(spec)  # dedicated actor worker: permanent
+            try:
+                self._apply_runtime_env(spec)  # dedicated worker: permanent
+            except Exception as e:  # noqa: BLE001 — malformed runtime_env
+                err = TaskError(spec.name, ValueError(f"bad runtime_env: {e!r}"))
+                return {
+                    "results": [
+                        (oid.binary(), "error", pickle.dumps(err))
+                        for oid in spec.return_ids
+                    ]
+                }
             return await self._run_async_method(spec, method)
         caller = spec.owner.worker_id if spec.owner else b""
         if self._max_concurrency == 1 and not spec.concurrency_group:
@@ -271,12 +282,10 @@ class TaskExecutor:
             return None
         if not isinstance(env_vars, dict):
             raise ValueError(f"env_vars must be a dict, got {type(env_vars).__name__}")
-        import os
-
         if spec.kind != TaskKind.NORMAL or spec.actor_id is not None:
             os.environ.update({k: str(v) for k, v in env_vars.items()})
             return None
-        self._env_gen = getattr(self, "_env_gen", 0) + 1
+        self._env_gen += 1
         my_gen = self._env_gen
         saved = {k: os.environ.get(k) for k in env_vars}
         os.environ.update({k: str(v) for k, v in env_vars.items()})

@@ -1,0 +1,44 @@
+"""Operator CLI (reference ``ray start/stop/status/list``,
+``scripts/scripts.py``)."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+ENV = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _cli(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", *args],
+        capture_output=True, text=True, env=ENV, timeout=timeout,
+    )
+
+
+def test_cli_start_status_list_stop(tmp_path):
+    out = _cli("start", "--head", "--num-cpus", "2",
+               "--session-dir", str(tmp_path / "sess"))
+    assert out.returncode == 0, out.stderr
+    addr = re.search(r"address: (\S+)", out.stdout).group(1)
+    try:
+        out2 = _cli("start", "--address", addr, "--num-cpus", "1")
+        assert out2.returncode == 0, out2.stderr
+        time.sleep(2)
+
+        st = _cli("status", "--address", addr)
+        assert st.returncode == 0, st.stderr
+        assert "cluster: 2 node(s)" in st.stdout
+        assert "CPU" in st.stdout
+
+        ls = _cli("list", "nodes", "--address", addr)
+        assert ls.returncode == 0, ls.stderr
+        rows = json.loads(ls.stdout)
+        assert len(rows) == 2
+    finally:
+        stop = _cli("stop")
+        assert "stopped" in stop.stdout
