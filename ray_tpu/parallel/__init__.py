@@ -24,7 +24,7 @@ from ray_tpu.parallel.sharding import (
     logical_to_sharding,
     shard_params_fsdp,
 )
-from ray_tpu.parallel.collectives import CollectiveGroup, ObjectStoreCollectives
+from ray_tpu.parallel.collectives import CollectiveGroup
 
 __all__ = [
     "pipeline_apply",
@@ -42,5 +42,4 @@ __all__ = [
     "logical_to_sharding",
     "shard_params_fsdp",
     "CollectiveGroup",
-    "ObjectStoreCollectives",
 ]

@@ -226,8 +226,3 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
 def recv(src_rank: int, group_name: str = "default"):
     return _groups[group_name].recv(src_rank)
 
-
-class ObjectStoreCollectives:
-    """Alias namespace for discoverability."""
-
-    Group = CollectiveGroup

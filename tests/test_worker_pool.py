@@ -53,7 +53,6 @@ def test_oom_killer_picks_newest_leased_worker():
     """Memory-monitor policy (reference WorkerKillingPolicy): under
     memory pressure the NEWEST leased task worker dies; actors and idle
     workers are spared. Uses an injected availability reading."""
-    import asyncio
     import time as _t
 
     ray_tpu.shutdown()
