@@ -32,6 +32,9 @@ class GlobalConfig:
     # Spill to disk when the store is above this fraction of capacity.
     object_spilling_threshold: float = 0.8
     object_spilling_dir: str = ""
+    # Per-process cap on the segment reuse pool (plasma-arena-style warm
+    # page recycling in StoreClient; 0 disables recycling).
+    object_store_recycle_bytes: int = 512 * 1024**2
 
     # --- scheduling ---
     # Hybrid policy: prefer local node until it exceeds this utilization
@@ -59,8 +62,16 @@ class GlobalConfig:
     #: how long an idle held lease waits for more same-class work before
     #: being returned
     lease_linger_s: float = 0.02
-    #: specs per push RPC on a held lease (serial worker-side execution)
-    lease_push_batch: int = 8
+    #: specs per push RPC on a held lease (serial worker-side execution);
+    #: the adaptive divisor in _drain_on_lease shrinks batches once pumps
+    #: fan out, so this is the micro-task amortization ceiling
+    lease_push_batch: int = 32
+    #: a pump spawns a sibling when its push has been in flight this long
+    #: with work still queued (demand-adaptive lease pipelining: micro
+    #: tasks amortize on one lease; long/blocked tasks fan out to more
+    #: workers). Must sit well above micro-task push round-trips even on
+    #: a contended box, or noop floods cascade into eager fan-out.
+    lease_pump_growth_s: float = 0.05
 
     # --- observability ---
     #: serve a Prometheus /metrics endpoint from daemons + controller

@@ -116,6 +116,12 @@ class CoreWorker(RuntimeBackend):
         # lease-reuse submission (per scheduling class)
         self._class_queues: Dict[Any, "_ClassQueue"] = {}
         self._retries_left: Dict[bytes, int] = {}
+        # submit batching: specs buffer on the caller thread and drain in
+        # one loop callback — call_soon_threadsafe once per burst instead
+        # of run_coroutine_threadsafe (a new Task) per task.
+        self._submit_buf: List[Tuple[bool, TaskSpec]] = []
+        self._submit_lock = threading.Lock()
+        self._submit_scheduled = False
         # streaming generators (``task_manager.h:102`` ObjectRefStream).
         # Locked: item pushes land on the io loop while abandon runs on
         # the consumer/GC thread — an unordered pop could leak the hold
@@ -224,12 +230,32 @@ class CoreWorker(RuntimeBackend):
             return await self._get_owned(ref, deadline)
         return await self._get_borrowed(ref, deadline)
 
+    async def _await_owned_ready(self, oid: ObjectID, deadline: Optional[float]):
+        """Event-driven completion wait on the io loop — no executor-thread
+        dispatch per ref (a 200-ref get would otherwise pay 200 thread
+        round-trips)."""
+        obj = self.refcounter.get(oid)
+        if obj is not None and obj.ready():
+            return obj
+        loop = asyncio.get_event_loop()
+        ev = asyncio.Event()
+        cb = lambda: loop.call_soon_threadsafe(ev.set)  # noqa: E731
+        if not self.refcounter.on_ready(oid, cb):
+            try:
+                timeout = (
+                    None if deadline is None else max(0.0, deadline - time.monotonic())
+                )
+                await asyncio.wait_for(ev.wait(), timeout)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
+            finally:
+                self.refcounter.remove_ready_callback(oid, cb)
+        return self.refcounter.get(oid)
+
     async def _get_owned(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
         oid = ref.id()
-        loop = asyncio.get_event_loop()
         while True:
-            timeout = None if deadline is None else max(0.0, deadline - time.monotonic())
-            obj = await loop.run_in_executor(None, self.refcounter.wait_ready, oid, timeout)
+            obj = await self._await_owned_ready(oid, deadline)
             if obj is None or not obj.ready():
                 raise GetTimeoutError(f"get() timed out waiting for {oid.hex()[:12]}")
             if obj.state == ObjState.FAILED:
@@ -433,10 +459,36 @@ class CoreWorker(RuntimeBackend):
     # free / refcounting
     def _on_free(self, oid: ObjectID, obj) -> None:
         self.memory.delete(oid)
-        self.shm.release(oid)
+        created_here = self.shm.has_created(oid)
+        recycle_pending = False
         for loc in obj.locations:
             _nid, host, port = loc
-            self.io.post(self._delete_remote(host, port, oid))
+            if created_here and _nid == self.node_id:
+                # our own segment: ask the daemon whether any reader ever
+                # resolved it — if not, the inode goes to the reuse pool
+                # (warm pages for the next put) instead of being unlinked
+                recycle_pending = True
+                self.io.post(self._delete_local_for_recycle(oid))
+            else:
+                self.io.post(self._delete_remote(host, port, oid))
+        if not recycle_pending:
+            # covers borrowed refs AND creator-side objects with no local
+            # location (e.g. adoption failed): the mapping must not leak
+            self.shm.release(oid)
+
+    async def _delete_local_for_recycle(self, oid: ObjectID) -> None:
+        try:
+            recyclable = await self.daemon.call(
+                "delete_object",
+                {"object_id": oid.binary(), "allow_recycle": True},
+                timeout=10,
+            )
+        except Exception:
+            recyclable = False
+        if recyclable is True:
+            self.shm.recycle(oid)
+        else:
+            self.shm.release(oid)
 
     async def _delete_remote(self, host, port, oid, timeout: float = 10.0):
         # Bounded: the target node may be dead or partitioned (that's often
@@ -491,7 +543,33 @@ class CoreWorker(RuntimeBackend):
             self.refcounter.create_pending(oid, lineage=spec, hold=True)
         self._pin_deps(spec)
         self.emit_task_event(spec, "SUBMITTED")
-        self.io.post(self._enqueue_normal(spec))
+        self._buffer_submit(False, spec)
+
+    def _buffer_submit(self, is_actor: bool, spec: TaskSpec) -> None:
+        with self._submit_lock:
+            self._submit_buf.append((is_actor, spec))
+            schedule = not self._submit_scheduled
+            if schedule:
+                self._submit_scheduled = True
+        if schedule:
+            self.io.loop.call_soon_threadsafe(self._drain_submits)
+
+    def _drain_submits(self) -> None:
+        """Runs on the io loop: dispatch every buffered spec."""
+        with self._submit_lock:
+            batch, self._submit_buf = self._submit_buf, []
+            self._submit_scheduled = False
+        for is_actor, spec in batch:
+            try:
+                if is_actor:
+                    self._enqueue_actor_task(spec)
+                else:
+                    self._enqueue_normal(spec)
+            except Exception as e:  # noqa: BLE001 — never strand returns
+                logger.exception("enqueue failed for %s", spec.name)
+                self._fail_returns(
+                    spec, e if isinstance(e, RayTpuError) else RayTpuError(repr(e))
+                )
 
     def _try_recover(self, oid: ObjectID, observed_locations=None) -> bool:
         """Lineage reconstruction (``object_recovery_manager.h:90``): if
@@ -524,7 +602,7 @@ class CoreWorker(RuntimeBackend):
                 _nid, host, port = loc
                 self.io.post(self._delete_remote(host, port, ret_id))
         self._pin_deps(spec)
-        self.io.post(self._enqueue_normal(spec))
+        self.io.loop.call_soon_threadsafe(self._enqueue_normal, spec)
         return True
 
     def _pin_deps(self, spec: TaskSpec) -> None:
@@ -550,7 +628,9 @@ class CoreWorker(RuntimeBackend):
             repr(spec.scheduling_strategy),
         )
 
-    async def _enqueue_normal(self, spec: TaskSpec) -> None:
+    def _enqueue_normal(self, spec: TaskSpec) -> None:
+        """Queue a normal task for lease-reuse submission. Must run on the
+        io loop (touches the class-queue/pump state)."""
         key = self._sched_class_key(spec)
         q = self._class_queues.get(key)
         if q is None:
@@ -558,8 +638,12 @@ class CoreWorker(RuntimeBackend):
         q.specs.append(spec)
         q.work.set()
         self._retries_left[spec.task_id.binary()] = spec.max_retries
-        if q.pumps < min(GLOBAL_CONFIG.max_lease_pumps, len(q.specs)):
-            q.pumps += 1
+        # One pump to start; growth is demand-driven (see _drain_on_lease):
+        # eager fan-out costs more than it buys for micro-tasks (lease
+        # churn + worker wakeups), while slow tasks trigger sibling pumps
+        # within lease_pump_growth_s anyway.
+        if q.pumps == 0:
+            q.pumps = 1
             if len(self._pump_tasks) > 64:
                 self._pump_tasks = [t for t in self._pump_tasks if not t.done()]
             self._pump_tasks.append(
@@ -579,7 +663,7 @@ class CoreWorker(RuntimeBackend):
                         self._finalize_spec(s, error=e)
                     return
                 try:
-                    await self._drain_on_lease(q, grant)
+                    await self._drain_on_lease(key, q, grant)
                 finally:
                     try:
                         await self._client(
@@ -597,10 +681,21 @@ class CoreWorker(RuntimeBackend):
             if q.pumps == 0 and not q.specs:
                 self._class_queues.pop(key, None)
 
-    async def _drain_on_lease(self, q: "_ClassQueue", grant: Dict[str, Any]) -> None:
+    def _maybe_grow_pumps(self, key, q: "_ClassQueue") -> None:
+        """A push has been in flight past the growth threshold with work
+        still queued: the tasks are long (or blocked) enough that another
+        lease is worth its churn — spawn a sibling pump."""
+        if q.specs and 0 < q.pumps < GLOBAL_CONFIG.max_lease_pumps:
+            q.pumps += 1
+            self._pump_tasks.append(
+                asyncio.ensure_future(self._pump_class(key, q, q.specs[0]))
+            )
+
+    async def _drain_on_lease(self, key, q: "_ClassQueue", grant: Dict[str, Any]) -> None:
         """Push queued specs onto one held lease until the queue runs dry
         (with a short linger for stragglers) or the worker dies."""
         worker_client = self._client(grant["host"], grant["port"])
+        loop = asyncio.get_event_loop()
         while True:
             if not q.specs:
                 # Linger: hold the lease briefly for follow-on work, but
@@ -647,6 +742,9 @@ class CoreWorker(RuntimeBackend):
                     grant["host"],
                     grant["port"],
                 )
+            grow_handle = loop.call_later(
+                GLOBAL_CONFIG.lease_pump_growth_s, self._maybe_grow_pumps, key, q
+            )
             try:
                 reply = await worker_client.call(
                     "push_batch",
@@ -687,6 +785,7 @@ class CoreWorker(RuntimeBackend):
                     )
                 return
             finally:
+                grow_handle.cancel()
                 for spec in batch:
                     self._inflight_workers.pop(spec.task_id.binary(), None)
             replies = reply["replies"]
@@ -1038,12 +1137,13 @@ class CoreWorker(RuntimeBackend):
         for oid in spec.return_ids:
             self.refcounter.create_pending(oid, hold=True)
         self._pin_deps(spec)
-        self.io.post(self._enqueue_actor_task(spec))
+        self._buffer_submit(True, spec)
 
-    async def _enqueue_actor_task(self, spec: TaskSpec) -> None:
+    def _enqueue_actor_task(self, spec: TaskSpec) -> None:
         """Per-actor ordered dispatch (``SequentialActorSubmitQueue``):
         calls to a max_concurrency==1 actor are pushed strictly in
-        submission order; concurrent/async actors dispatch directly."""
+        submission order; concurrent/async actors dispatch directly.
+        Must run on the io loop."""
         with self._actors_lock:
             st = self._actors.setdefault(spec.actor_id, _ActorState())
         if st.max_concurrency > 1:
@@ -1056,9 +1156,26 @@ class CoreWorker(RuntimeBackend):
         q.put_nowait(spec)
 
     async def _actor_pump(self, actor_id: ActorID, q: "asyncio.Queue") -> None:
+        # Batched ordered pushes: pop everything queued and send ONE
+        # framed RPC (the worker executes the batch serially, seq-ordered)
+        # — the round-trip amortizes across the burst exactly like the
+        # normal-task lease pipelining, while strict submission order is
+        # preserved even across worker restarts (the whole batch retries
+        # in order).
         while not self._stopping:
             spec = await q.get()
-            await self._submit_actor(spec)
+            batch = [spec]
+            limit = GLOBAL_CONFIG.lease_push_batch
+            while len(batch) < limit and not q.empty():
+                batch.append(q.get_nowait())
+            try:
+                await self._submit_actor_batch(batch)
+            except Exception as e:  # noqa: BLE001 — the pump must survive
+                logger.exception("actor batch submission failed")
+                for s in batch:
+                    self._fail_returns(
+                        s, e if isinstance(e, RayTpuError) else RayTpuError(repr(e))
+                    )
 
     async def _submit_actor(self, spec: TaskSpec) -> None:
         try:
@@ -1066,6 +1183,88 @@ class CoreWorker(RuntimeBackend):
         except Exception as e:  # noqa: BLE001 — never leave returns pending
             logger.exception("actor task %s submission failed", spec.name)
             self._fail_returns(spec, e if isinstance(e, RayTpuError) else RayTpuError(repr(e)))
+
+    async def _submit_actor_batch(self, batch: List[TaskSpec]) -> None:
+        """Push an ordered batch of calls to one actor; retries keep order
+        (the whole remaining batch is re-pushed after a restart)."""
+        actor_id = batch[0].actor_id
+        all_specs = list(batch)
+        with self._actors_lock:
+            st = self._actors.setdefault(actor_id, _ActorState())
+        retries_left = {s.task_id.binary(): st.max_task_retries for s in batch}
+        try:
+            while batch:
+                try:
+                    st = await self._resolve_actor(actor_id)
+                except Exception as e:  # noqa: BLE001
+                    for s in batch:
+                        self._fail_returns(s, RayTpuError(repr(e)))
+                    return
+                if st.state == "DEAD":
+                    for s in batch:
+                        self._fail_returns(
+                            s, ActorDiedError(actor_id, st.reason or "actor is dead")
+                        )
+                    return
+                client = self._client(st.address.host, st.address.port)
+                try:
+                    reply = await client.call(
+                        "push_batch", {"specs": batch}, timeout=None, connect_timeout=3.0
+                    )
+                except ConnectionLost:
+                    try:
+                        info = await self.controller.call(
+                            "get_actor_info", {"actor_id": actor_id}
+                        )
+                    except Exception:
+                        # controller blip ≠ actor death: retry the resolve
+                        # loop (bounded by _resolve_actor's own deadline)
+                        await asyncio.sleep(0.2)
+                        continue
+                    with self._actors_lock:
+                        if info is not None:
+                            st.state = info["state"]
+                            st.address = info["address"]
+                            st.reason = info.get("reason", "")
+                        else:
+                            st.state = "DEAD"
+                    survivors: List[TaskSpec] = []
+                    for s in batch:
+                        tid = s.task_id.binary()
+                        if st.state == "DEAD" or retries_left[tid] <= 0:
+                            self._fail_returns(
+                                s,
+                                ActorDiedError(
+                                    actor_id, st.reason or "actor worker died mid-call"
+                                ),
+                            )
+                        else:
+                            retries_left[tid] -= 1
+                            survivors.append(s)
+                    batch = survivors
+                    if batch:
+                        await asyncio.sleep(0.1)
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    for s in batch:
+                        self._fail_returns(
+                            s, e if isinstance(e, RayTpuError) else RayTpuError(repr(e))
+                        )
+                    return
+                replies = reply["replies"]
+                for i, s in enumerate(batch):
+                    if i >= len(replies):
+                        self._fail_returns(s, RayTpuError("push_batch reply truncated"))
+                        continue
+                    try:
+                        self._process_reply(s, replies[i], 0)
+                    except Exception as e:  # noqa: BLE001
+                        logger.exception("reply processing failed for %s", s.name)
+                        self._fail_returns(s, RayTpuError(repr(e)))
+                return
+        finally:
+            for s in all_specs:
+                self._unpin_deps(s)
 
     async def _submit_actor_inner(self, spec: TaskSpec) -> None:
         try:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import weakref
 from typing import Any, Callable, Dict, Optional
 
 import cloudpickle
@@ -26,20 +27,41 @@ class FunctionTable:
         self._kv_get = kv_get
         self._exported: Dict[bytes, bytes] = {}
         self._cache: Dict[bytes, Any] = {}
+        # identity → function_id: export() sits on the per-submit hot path,
+        # so the cloudpickle+sha256 of an already-exported callable must be
+        # skipped. Weak keys: a redefined function is a different object
+        # (gets its own export), and dropped functions don't pin entries.
+        self._by_identity: "weakref.WeakKeyDictionary[Any, bytes]" = (
+            weakref.WeakKeyDictionary()
+        )
         self._lock = threading.Lock()
 
     def export(self, obj: Any) -> bytes:
         """Pickle `obj` (function or class), store under its hash, return id."""
         from ray_tpu.core.serialization import ensure_importable_or_by_value
 
+        try:
+            hit = self._by_identity.get(obj)
+        except TypeError:  # unhashable / non-weakrefable callable
+            hit = None
+        if hit is not None:
+            return hit
         ensure_importable_or_by_value(obj)
         payload = cloudpickle.dumps(obj)
         function_id = hashlib.sha256(payload).digest()[:16]
         with self._lock:
             if function_id in self._exported:
+                try:
+                    self._by_identity[obj] = function_id
+                except TypeError:
+                    pass
                 return function_id
             self._exported[function_id] = payload
             self._cache[function_id] = obj
+            try:
+                self._by_identity[obj] = function_id
+            except TypeError:
+                pass
         self._kv_put(FUNCTION_KV_PREFIX + function_id, payload)
         return function_id
 

@@ -505,6 +505,7 @@ class NodeDaemon:
                     if lease.worker is w:
                         self._release_lease(lease_id)
                 self._release_actor_resources(w)
+                self._sweep_recycle_pool(w.proc.pid)
                 if w.actor_id is not None:
                     try:
                         await self.controller.call(
@@ -522,6 +523,19 @@ class NodeDaemon:
                 self._last_oom_check = now
                 self._oom_check()
             await asyncio.sleep(0.1)
+
+    @staticmethod
+    def _sweep_recycle_pool(pid: int) -> None:
+        """Unlink a dead worker's segment-reuse pool files (named
+        ``rt-pool-<pid>-<n>`` by StoreClient.recycle) so they don't leak
+        tmpfs memory past the process's lifetime."""
+        import glob
+
+        for path in glob.glob(f"/dev/shm/rt-pool-{pid}-*"):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def _kill_idle_workers(self) -> None:
         """Reference ``idle_worker_killing``: pooled workers idle past the
@@ -892,8 +906,14 @@ class NodeDaemon:
         return data
 
     async def d_delete_object(self, payload, conn):
-        self.store.delete(ObjectID(payload["object_id"]))
-        return True
+        """Delete an object. ``allow_recycle`` is sent by the deleting
+        OWNER (segment creator): if no reader ever resolved the object
+        here, the entry is dropped WITHOUT unlinking and True is returned
+        — the caller renames the inode into its warm-page reuse pool."""
+        return self.store.delete(
+            ObjectID(payload["object_id"]),
+            allow_recycle=bool(payload.get("allow_recycle")),
+        )
 
     def _peer(self, host: str, port: int) -> RpcClient:
         key = (host, port)
