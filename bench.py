@@ -187,8 +187,31 @@ def bench_tpu(results: Dict[str, Dict]) -> None:
     results["jax_backend"] = {"value": backend, "unit": ""}
     on_tpu = backend == "tpu"
 
-    # flash attention vs XLA reference, short + long context
-    from ray_tpu.ops.attention import flash_attention, reference_attention
+    # MFU denominator: the chip's public dense-bf16 peak
+    from ray_tpu.accelerators.tpu import peak_bf16_tflops
+
+    peak = None
+    if on_tpu:
+        kind = jax.devices()[0].device_kind
+        peak = peak_bf16_tflops(kind)
+        results["chip"] = {"value": kind, "unit": ""}
+        results["chip_peak_tflops"] = {"value": peak, "unit": "TFLOP/s bf16"}
+
+    def mfu(tflops: float) -> Optional[float]:
+        return round(tflops / peak, 4) if peak else None
+
+    # flash attention vs XLA, short + long context. The XLA baseline is
+    # jax.nn.dot_product_attention — a tuned path a user would actually
+    # reach for — NOT the naive O(S^2)-materializing oracle (which HBM-
+    # thrashes at long context and would flatter the kernel).
+    from ray_tpu.ops.attention import flash_attention
+
+    def xla_dpa(q, k, v):
+        # our layout is (b, h, s, d); jax.nn wants (b, s, h, d)
+        out = jax.nn.dot_product_attention(
+            q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), is_causal=True
+        )
+        return out.swapaxes(1, 2)
 
     impl = "pallas" if on_tpu else "xla"
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
@@ -200,28 +223,38 @@ def bench_tpu(results: Dict[str, Dict]) -> None:
         v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), dtype)
         flops = 4.0 * b * h * s * s * d * 0.5  # causal ≈ half the score matrix
         fa = functools.partial(flash_attention, causal=True, impl=impl)
-        ref = functools.partial(reference_attention, causal=True)
-        for name, fn in [(f"flash_attention_s{s}", fa), (f"xla_attention_s{s}", ref)]:
+        for name, fn in [(f"flash_attention_s{s}", fa), (f"xla_attention_s{s}", xla_dpa)]:
             iters = 30 if s <= 2048 else 10
             dt = _bench_chained(fn, q, k, v, iters=iters)
-            results[f"{name}_tflops"] = {"value": round(flops / dt / 1e12, 2), "unit": "TFLOP/s"}
+            tf = round(flops / dt / 1e12, 2)
+            results[f"{name}_tflops"] = {"value": tf, "unit": "TFLOP/s", "mfu": mfu(tf)}
             print(f"  {name}: {results[f'{name}_tflops']}", file=sys.stderr, flush=True)
 
-    # tiny-Llama train step throughput (tokens/s) on one chip
+    # Llama train step on one chip: the largest config that comfortably
+    # fits a single chip's HBM (so remat/donation/layout decisions are
+    # actually exercised), with MFU against the chip peak.
     import optax
 
     from ray_tpu.models.llama import LlamaConfig, init_params, make_train_step
 
-    cfg = LlamaConfig(
-        vocab_size=8192, dim=512, n_layers=8, n_heads=8, n_kv_heads=8,
-        mlp_hidden=1536, max_seq_len=1024,
-        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-    )
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, dim=1024, n_layers=24, n_heads=16, n_kv_heads=16,
+            mlp_hidden=4096, max_seq_len=2048, dtype=jnp.bfloat16,
+        )
+        batch, seq, remat = 8, 2048, True
+    else:
+        cfg = LlamaConfig(
+            vocab_size=8192, dim=512, n_layers=8, n_heads=8, n_kv_heads=8,
+            mlp_hidden=1536, max_seq_len=1024, dtype=jnp.float32,
+        )
+        batch, seq, remat = 2, 256, False
     params = init_params(cfg, jax.random.PRNGKey(0))
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    results["train_model_params"] = {"value": n_params, "unit": "params"}
     opt = optax.adamw(1e-3)
     opt_state = jax.jit(opt.init)(params)
-    step = make_train_step(cfg, opt, remat=False, donate=True)
-    batch, seq = (8, 1024) if on_tpu else (2, 256)
+    step = make_train_step(cfg, opt, remat=remat, donate=True)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size, jnp.int32)
     bd = {"tokens": tokens, "targets": tokens}
     state = (params, opt_state)
@@ -233,10 +266,14 @@ def bench_tpu(results: Dict[str, Dict]) -> None:
         state, loss = step(state, bd)  # state chains: serialized by data dep
     float(loss)
     dt = (time.perf_counter() - start) / iters
-    results["train_tokens_per_s"] = {
-        "value": round(batch * seq / dt, 1), "unit": "tokens/s",
-    }
-    print(f"  train_tokens_per_s: {results['train_tokens_per_s']}", file=sys.stderr, flush=True)
+    tok_s = batch * seq / dt
+    # standard 6ND accounting (fwd+bwd; remat recompute not credited)
+    train_tflops = 6.0 * n_params * tok_s / 1e12
+    results["train_tokens_per_s"] = {"value": round(tok_s, 1), "unit": "tokens/s"}
+    results["train_tflops"] = {"value": round(train_tflops, 2), "unit": "TFLOP/s"}
+    results["train_mfu"] = {"value": mfu(train_tflops), "unit": "fraction of chip peak"}
+    for k in ("train_tokens_per_s", "train_tflops", "train_mfu"):
+        print(f"  {k}: {results[k]}", file=sys.stderr, flush=True)
 
 
 def main() -> None:
@@ -265,13 +302,21 @@ def main() -> None:
     with open(details_path, "w") as f:
         json.dump(results, f, indent=1)
 
-    # headline: TPU training throughput if available, else task throughput
+    # Headline: TPU training throughput if available, else task throughput.
+    # The reference publishes NO TPU tokens/s baseline (BASELINE.json
+    # `published: {}`), so the training headline's vs_baseline is honestly
+    # null — MFU (details) is the absolute quality measure; the runtime
+    # metrics carry real vs_baseline ratios against the 2.22.0 release logs.
     if "train_tokens_per_s" in results and "value" in results.get("train_tokens_per_s", {}):
         headline = {
             "metric": "train_tokens_per_s",
             "value": results["train_tokens_per_s"]["value"],
             "unit": "tokens/s",
-            "vs_baseline": results.get("tasks_async_per_s", {}).get("vs_baseline", 0.0),
+            "vs_baseline": None,
+            "mfu": results.get("train_mfu", {}).get("value"),
+            "tasks_async_vs_baseline": results.get("tasks_async_per_s", {}).get(
+                "vs_baseline"
+            ),
         }
     else:
         r = results.get("tasks_async_per_s", {"value": 0.0})

@@ -52,6 +52,32 @@ _CHIPS_PER_HOST = {
 # generations whose pod-type suffix counts TensorCores (2/chip), not chips
 _CORES_SUFFIXED = {"v2", "v3", "v4", "v5p"}
 
+# Public per-chip bf16 peak (dense) in TFLOP/s, keyed by substrings of
+# ``jax.Device.device_kind`` — the denominator for MFU reporting. Longest
+# match wins ("v5 lite" before "v5").
+_PEAK_BF16_TFLOPS = {
+    "v2": 46.0,
+    "v3": 123.0,
+    "v4": 275.0,
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+}
+
+
+def peak_bf16_tflops(device_kind: str) -> Optional[float]:
+    """Per-chip dense-bf16 peak for a jax ``device_kind`` string (e.g.
+    ``"TPU v5 lite"``); None when unknown."""
+    kind = device_kind.lower()
+    best = None
+    best_len = 0
+    for key, peak in _PEAK_BF16_TFLOPS.items():
+        if key in kind and len(key) > best_len:
+            best, best_len = peak, len(key)
+    return best
+
 
 # ---------------------------------------------------------------------------
 # Metadata access — injectable for tests (reference probes GCE/GKE metadata)

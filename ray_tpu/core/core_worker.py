@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import pickle
 import threading
 import time
@@ -46,6 +47,7 @@ from ray_tpu.core.exceptions import (
 )
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import MemoryStore, StoreClient
+from ray_tpu.core.object_store import segment_name as _segment_name
 from ray_tpu.core.ownership import ObjState, ReferenceCounter
 from ray_tpu.core.refs import Address, ObjectRef
 from ray_tpu.core.rpc import ConnectionLost, IoThread, RpcClient, RpcServer
@@ -484,7 +486,17 @@ class CoreWorker(RuntimeBackend):
                 timeout=10,
             )
         except Exception:
-            recyclable = False
+            # Reply lost: the daemon may have granted recycling (entry
+            # dropped, file NOT unlinked) — unlink defensively or the
+            # segment leaks outside all accounting. The object is freed
+            # either way, and a daemon-side _drop of a missing file is a
+            # handled no-op.
+            self.shm.release(oid)
+            try:
+                os.unlink("/dev/shm/" + _segment_name(oid))
+            except OSError:
+                pass
+            return
         if recyclable is True:
             self.shm.recycle(oid)
         else:
@@ -1212,15 +1224,15 @@ class CoreWorker(RuntimeBackend):
                         "push_batch", {"specs": batch}, timeout=None, connect_timeout=3.0
                     )
                 except ConnectionLost:
-                    try:
-                        info = await self.controller.call(
-                            "get_actor_info", {"actor_id": actor_id}
-                        )
-                    except Exception:
-                        # controller blip ≠ actor death: retry the resolve
-                        # loop (bounded by _resolve_actor's own deadline)
-                        await asyncio.sleep(0.2)
-                        continue
+                    # controller consult is NOT guarded: if the control
+                    # plane is also gone there is nothing to wait for —
+                    # the exception propagates to the pump's catch, which
+                    # fails the batch returns (matches the old per-call
+                    # path; a guarded retry here would loop forever on the
+                    # cached ALIVE state)
+                    info = await self.controller.call(
+                        "get_actor_info", {"actor_id": actor_id}
+                    )
                     with self._actors_lock:
                         if info is not None:
                             st.state = info["state"]

@@ -518,6 +518,7 @@ class NodeDaemon:
                     except Exception:
                         pass
             self._kill_idle_workers()
+            self._sweep_orphan_pools()
             now = time.monotonic()
             if now - self._last_oom_check >= GLOBAL_CONFIG.memory_monitor_period_s:
                 self._last_oom_check = now
@@ -536,6 +537,35 @@ class NodeDaemon:
                 os.unlink(path)
             except OSError:
                 pass
+
+    _pool_orphan_sweep_period_s = 10.0
+    _last_pool_orphan_sweep = 0.0
+
+    def _sweep_orphan_pools(self) -> None:
+        """Reap pool files whose owning pid is dead — covers DRIVERS and
+        externally-started processes the worker-reap path never sees
+        (SIGKILL'd drivers would otherwise shrink usable store capacity
+        forever, since pool files count as used in admission control)."""
+        import glob
+
+        now = time.monotonic()
+        if now - self._last_pool_orphan_sweep < self._pool_orphan_sweep_period_s:
+            return
+        self._last_pool_orphan_sweep = now
+        for path in glob.glob("/dev/shm/rt-pool-*"):
+            try:
+                pid = int(os.path.basename(path).split("-")[2])
+            except (IndexError, ValueError):
+                continue
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            except PermissionError:
+                pass  # pid alive under another uid
 
     def _kill_idle_workers(self) -> None:
         """Reference ``idle_worker_killing``: pooled workers idle past the

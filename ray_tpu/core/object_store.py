@@ -436,7 +436,10 @@ class StoreClient:
         rename it to the new object's name. Same inode → warm pages."""
         with self._lock:
             for i, (name, seg) in enumerate(self._pool):
-                if seg.size >= size and seg.size <= max(2 * size, size + (16 << 20)):
+                # Tight fit only: physical slack beyond the logical size is
+                # invisible to the daemon's accounting (entries record the
+                # logical size), so bound it at 12.5% / 1 MiB.
+                if seg.size >= size and seg.size <= size + max(size >> 3, 1 << 20):
                     del self._pool[i]
                     self._pool_bytes -= seg.size
                     try:
