@@ -86,6 +86,11 @@ def remote(*args, **kwargs):
 
 
 def get(refs, *, timeout: Optional[float] = None):
+    # compiled-graph results carry their own channel-backed get
+    from ray_tpu.dag.compiled import CompiledDAGRef
+
+    if isinstance(refs, CompiledDAGRef):
+        return refs.get(timeout)
     return _api._global_worker().get(refs, timeout=timeout)
 
 

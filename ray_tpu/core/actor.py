@@ -62,7 +62,17 @@ class ActorMethod:
 
 
 class ActorHandle:
-    """Serializable reference to a running actor."""
+    """Serializable reference to a running actor.
+
+    Lifetime (reference ``actor.py`` handle semantics): the handle
+    returned by ``Cls.remote()`` OWNS an anonymous non-detached actor —
+    when it is garbage-collected the actor is terminated, freeing its
+    resources. Deserialized copies and ``get_actor`` lookups are borrowed
+    and never terminate on drop. NAMED actors are registry-reachable
+    (``get_actor``) and therefore exempt, as are ``lifetime="detached"``
+    actors — both die only via ``kill``/job end. (The reference refcounts
+    every live handle cluster-wide; creator-handle ownership is this
+    build's approximation.)"""
 
     def __init__(
         self,
@@ -71,14 +81,37 @@ class ActorHandle:
         owner: Optional[Address],
         name: Optional[str] = None,
         namespace: Optional[str] = None,
+        owned: bool = False,
     ):
         self._actor_id = actor_id
         self._method_opts = method_opts
         self._owner = owner
         self._name = name
         self._namespace = namespace
+        self._owned = owned
         self._seq_lock = threading.Lock()
         self._seq_no = 0
+
+    def __del__(self):
+        if not getattr(self, "_owned", False):
+            return
+        try:
+            from ray_tpu.core.api import get_global_worker_or_none
+
+            w = get_global_worker_or_none()
+            if w is None:
+                return
+            # Graceful out-of-scope termination (reference actor GC):
+            # __ray_terminate__ rides the per-actor ORDERED submit queue,
+            # so every call submitted before the handle dropped drains
+            # first; restarts are disabled via a non-blocking control
+            # message. Everything here is fire-and-forget — cyclic GC can
+            # run __del__ on any thread (including the io loop), where a
+            # blocking RPC wait would deadlock the driver.
+            w.backend.mark_actor_no_restart(self._actor_id)
+            self._submit_method("__ray_terminate__", (), {}, {})
+        except Exception:
+            pass  # interpreter teardown / backend already gone
 
     @property
     def actor_id(self) -> ActorID:
@@ -199,6 +232,7 @@ class ActorClass:
             worker.address,
             name=opts.name,
             namespace=opts.namespace or worker.namespace,
+            owned=opts.lifetime != "detached" and opts.name is None,
         )
 
     def bind(self, *args, **kwargs):

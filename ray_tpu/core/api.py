@@ -59,6 +59,14 @@ class RuntimeBackend(ABC):
     @abstractmethod
     def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None: ...
 
+    def kill_actor_nowait(self, actor_id: ActorID) -> None:
+        """Fire-and-forget kill, safe from GC/finalizer contexts."""
+        self.kill_actor(actor_id, True)
+
+    def mark_actor_no_restart(self, actor_id: ActorID) -> None:
+        """Disable restarts ahead of a graceful termination (no-op where
+        restarts don't exist)."""
+
     @abstractmethod
     def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None: ...
 

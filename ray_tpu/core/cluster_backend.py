@@ -21,11 +21,14 @@ from ray_tpu.core.core_worker import CoreWorker
 
 def _subprocess_env() -> dict:
     """Env for child processes: make the ray_tpu package importable even
-    when the driver found it via sys.path manipulation."""
+    when the driver found it via sys.path manipulation, and strip env
+    triggers that would start per-process accelerator tunnel clients in
+    pure control-plane daemons (see ``GlobalConfig.strip_child_env``)."""
     import ray_tpu
+    from ray_tpu.core.config import scrub_child_env
 
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
-    env = dict(os.environ)
+    env = scrub_child_env(dict(os.environ))
     existing = env.get("PYTHONPATH", "")
     if pkg_root not in existing.split(os.pathsep):
         env["PYTHONPATH"] = pkg_root + (os.pathsep + existing if existing else "")

@@ -95,6 +95,17 @@ class GlobalConfig:
     memory_monitor_min_available_fraction: float = 0.03
     memory_monitor_period_s: float = 1.0
 
+    # --- process environment ---
+    #: comma-separated env vars STRIPPED from spawned runtime processes
+    #: (control-plane daemons, CPU workers, shm resource trackers). The
+    #: default strips the axon TPU-tunnel trigger: when set, this host's
+    #: sitecustomize registers a PJRT tunnel client in EVERY python
+    #: process, which burns ~half a core per process polling the relay —
+    #: daemons and CPU-only workers must not pay that tax. Workers that
+    #: are ASSIGNED TPU chips keep their env untouched. Set
+    #: RAY_TPU_strip_child_env="" to disable.
+    strip_child_env: str = "PALLAS_AXON_POOL_IPS"
+
     # --- RPC ---
     rpc_connect_timeout_s: float = 10.0
     rpc_retry_base_delay_s: float = 0.05
@@ -130,6 +141,29 @@ class GlobalConfig:
 
     def to_dict(self) -> Dict[str, Any]:
         return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+_STASH_PREFIX = "RAY_TPU_STASHED_"
+
+
+def scrub_child_env(env: Dict[str, str]) -> Dict[str, str]:
+    """Remove ``strip_child_env`` vars from a child-process env, STASHING
+    their values under ``RAY_TPU_STASHED_<key>`` so a descendant that
+    legitimately needs them (a TPU-assigned worker) can restore them via
+    :func:`restore_scrubbed_env`. Mutates and returns ``env``."""
+    for key in GLOBAL_CONFIG.strip_child_env.split(","):
+        if key and key in env:
+            env[_STASH_PREFIX + key] = env.pop(key)
+    return env
+
+
+def restore_scrubbed_env(env: Dict[str, str]) -> Dict[str, str]:
+    """Undo :func:`scrub_child_env` for a child that needs the stripped
+    vars (TPU-assigned workers). Mutates and returns ``env``."""
+    for key in list(env):
+        if key.startswith(_STASH_PREFIX):
+            env[key[len(_STASH_PREFIX):]] = env.pop(key)
+    return env
 
 
 def _parse(raw: str, typ: Any) -> Any:

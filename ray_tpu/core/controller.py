@@ -637,6 +637,11 @@ class Controller:
             return {"ok": False}
         if payload.get("no_restart", True):
             info.spec.max_restarts = 0
+        if payload.get("drain"):
+            # graceful out-of-scope termination: restarts are now off and
+            # the owner has enqueued __ray_terminate__ behind the actor's
+            # pending calls — do NOT kill the worker here
+            return {"ok": True}
         if info.address is not None and info.node_id in self.node_clients:
             try:
                 await self.node_clients[info.node_id].call(

@@ -739,7 +739,18 @@ class CoreWorker(RuntimeBackend):
             )
             batch: List[TaskSpec] = []
             while q.specs and len(batch) < limit:
-                spec = q.specs.popleft()
+                spec = q.specs[0]
+                # Batch-dependency guard: a spec whose owned dep is still
+                # PENDING must not ride behind its producer in ONE batch —
+                # the worker executes the batch serially and the producer's
+                # result only reaches this owner in the batched reply, so
+                # the dependent would deadlock waiting for it. Close the
+                # batch instead; the next push happens after this reply is
+                # processed. (Taken alone it may still block the lane on a
+                # dep produced elsewhere — that's latency, not deadlock.)
+                if batch and self._has_pending_owned_dep(spec):
+                    break
+                q.specs.popleft()
                 tid = spec.task_id.binary()
                 if tid in self._cancelled_tasks:
                     self._finalize_spec(
@@ -823,6 +834,13 @@ class CoreWorker(RuntimeBackend):
                     q.specs.appendleft(spec)
                 else:
                     self._finalize_spec(spec)
+
+    def _has_pending_owned_dep(self, spec: TaskSpec) -> bool:
+        for ref in spec.dependencies():
+            obj = self.refcounter.get(ref.id())
+            if obj is not None and not obj.ready():
+                return True
+        return False
 
     def _finalize_spec(self, spec: TaskSpec, error: Optional[Exception] = None) -> None:
         """A spec leaves the submission system: record failure (if any),
@@ -1174,12 +1192,21 @@ class CoreWorker(RuntimeBackend):
         # normal-task lease pipelining, while strict submission order is
         # preserved even across worker restarts (the whole batch retries
         # in order).
+        carry: Optional[TaskSpec] = None
         while not self._stopping:
-            spec = await q.get()
+            spec = carry if carry is not None else await q.get()
+            carry = None
             batch = [spec]
             limit = GLOBAL_CONFIG.lease_push_batch
             while len(batch) < limit and not q.empty():
-                batch.append(q.get_nowait())
+                nxt = q.get_nowait()
+                # same batch-dependency guard as the normal-task path: a
+                # call whose owned dep is pending (possibly produced by a
+                # batchmate) must start the NEXT batch
+                if self._has_pending_owned_dep(nxt):
+                    carry = nxt
+                    break
+                batch.append(nxt)
             try:
                 await self._submit_actor_batch(batch)
             except Exception as e:  # noqa: BLE001 — the pump must survive
@@ -1324,6 +1351,31 @@ class CoreWorker(RuntimeBackend):
         self.io.run(
             self.controller.call("kill_actor", {"actor_id": actor_id, "no_restart": no_restart})
         )
+
+    def kill_actor_nowait(self, actor_id: ActorID) -> None:
+        async def _kill():
+            try:
+                await self.controller.call(
+                    "kill_actor", {"actor_id": actor_id, "no_restart": True}
+                )
+            except Exception:
+                pass
+
+        if not self._stopping:
+            self.io.post(_kill())
+
+    def mark_actor_no_restart(self, actor_id: ActorID) -> None:
+        async def _mark():
+            try:
+                await self.controller.call(
+                    "kill_actor",
+                    {"actor_id": actor_id, "no_restart": True, "drain": True},
+                )
+            except Exception:
+                pass
+
+        if not self._stopping:
+            self.io.post(_mark())
 
     def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
         """Cancel the task producing ``ref`` (``CoreWorker::CancelTask``).

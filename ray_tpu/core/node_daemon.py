@@ -431,10 +431,21 @@ class NodeDaemon:
         env["RAY_TPU_DAEMON_ADDR"] = f"{self.host}:{self.port}"
         env["RAY_TPU_CONTROLLER_ADDR"] = f"{self.controller_addr[0]}:{self.controller_addr[1]}"
         env.pop("JAX_PLATFORMS", None)  # workers decide their own platform
+        # CPU workers: strip accelerator-tunnel env triggers (each one
+        # starts a per-process relay client burning ~half a core — see
+        # GlobalConfig.strip_child_env). TPU-assigned workers RESTORE the
+        # values the daemon's own spawn stashed (the daemon env is
+        # already scrubbed, so "keep" means un-stash, not skip-strip).
+        from ray_tpu.core.config import restore_scrubbed_env, scrub_child_env
+
+        chips = tpu_chips
+        if chips is None:
+            scrub_child_env(env)
+        else:
+            restore_scrubbed_env(env)
         # Dedicated actor workers get their chip isolation at spawn time —
         # before libtpu can initialize (TPU_VISIBLE_CHIPS + topology bounds,
         # reference accelerators/tpu.py:31).
-        chips = tpu_chips
         if chips is not None:
             from ray_tpu.accelerators.tpu import TPUAcceleratorManager
 
@@ -552,9 +563,17 @@ class NodeDaemon:
         if now - self._last_pool_orphan_sweep < self._pool_orphan_sweep_period_s:
             return
         self._last_pool_orphan_sweep = now
-        for path in glob.glob("/dev/shm/rt-pool-*"):
+        # rt-pool-<pid>-* (segment reuse pools), rt-chan-<pid>-* (compiled
+        # graph channels) and their sem.rt-chan-<pid>-* wakeup semaphores
+        # all embed the owning pid
+        for path in glob.glob("/dev/shm/rt-pool-*") + glob.glob(
+            "/dev/shm/rt-chan-*"
+        ) + glob.glob("/dev/shm/sem.rt-chan-*"):
+            base = os.path.basename(path)
+            if base.startswith("sem."):
+                base = base[4:]
             try:
-                pid = int(os.path.basename(path).split("-")[2])
+                pid = int(base.split("-")[2])
             except (IndexError, ValueError):
                 continue
             try:

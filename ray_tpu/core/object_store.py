@@ -40,6 +40,34 @@ def segment_name(object_id: ObjectID) -> str:
     return "rt-" + object_id.hex()
 
 
+_tracker_prestarted = False
+
+
+def ensure_scrubbed_tracker() -> None:
+    """Pre-spawn multiprocessing's shm resource tracker with accelerator
+    tunnel env triggers removed. The tracker is spawned lazily with the
+    CURRENT process env on first SharedMemory use; on hosts where an env
+    var makes sitecustomize start a per-process tunnel client, an
+    unscrubbed tracker burns ~half a core forever (and may never even
+    reach its serve loop). Idempotent; call before first shm touch."""
+    global _tracker_prestarted
+    if _tracker_prestarted:
+        return
+    _tracker_prestarted = True
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    keys = [k for k in GLOBAL_CONFIG.strip_child_env.split(",") if k]
+    saved = {k: os.environ.pop(k) for k in keys if k in os.environ}
+    # (scrub_child_env stashes for descendants; here the var must be GONE
+    # from the tracker's env entirely, so plain pop/restore is right.)
+    try:
+        resource_tracker.ensure_running()
+    except Exception:
+        pass
+    finally:
+        os.environ.update(saved)
+
+
 def _attach(name: str) -> shared_memory.SharedMemory:
     """Attach to an existing segment without the resource tracker claiming
     it (py3.12's tracker would unlink segments it never created when this
@@ -106,6 +134,7 @@ class ShmStore:
     """Daemon-side store authority. Thread-safe; no asyncio dependency."""
 
     def __init__(self, capacity_bytes: Optional[int] = None, spill_dir: Optional[str] = None):
+        ensure_scrubbed_tracker()
         self.capacity = capacity_bytes or GLOBAL_CONFIG.object_store_memory_bytes
         self.spill_dir = spill_dir or GLOBAL_CONFIG.object_spilling_dir or "/tmp/ray_tpu_spill"
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()  # LRU order
@@ -370,6 +399,7 @@ class StoreClient:
     them at memcpy speed."""
 
     def __init__(self):
+        ensure_scrubbed_tracker()
         self._attached: Dict[ObjectID, shared_memory.SharedMemory] = {}
         self._created: Dict[ObjectID, shared_memory.SharedMemory] = {}
         # reuse pool: (current_file_name, still-mapped segment)

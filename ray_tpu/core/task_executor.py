@@ -156,6 +156,24 @@ class TaskExecutor:
             reply = {"results": self._package(spec, [(spec.return_ids[0], None)])}
             self.core.io.loop.call_later(0.05, _exit_now)
             return reply
+        if spec.method_name == "__ray_dag_loop__":
+            # compiled-graph loop (``dag/compiled.py``): occupies the
+            # default lane until the driver tears the DAG down — the
+            # reply to this call IS the loop's exit signal
+            from ray_tpu.dag.compiled import run_dag_loop
+
+            loop = asyncio.get_event_loop()
+
+            def _run_loop():
+                args, _kwargs = execution.resolve_args(spec, self._get_dep)
+                run_dag_loop(self._actor_instance, args[0])
+
+            try:
+                await loop.run_in_executor(self._default_lane, _run_loop)
+                pairs = [(spec.return_ids[0], None)]
+            except Exception as e:  # noqa: BLE001
+                pairs = [(spec.return_ids[0], TaskError(spec.name, e))]
+            return {"results": await loop.run_in_executor(None, self._package, spec, pairs)}
         method = getattr(self._actor_instance, spec.method_name, None)
         if method is None:
             err = TaskError(spec.name, AttributeError(f"no method {spec.method_name!r}"))

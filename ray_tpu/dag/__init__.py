@@ -1,0 +1,39 @@
+"""Compiled graphs (aDAG): static actor DAGs over mutable shm channels.
+
+Reference: ``python/ray/dag/`` + ``python/ray/experimental/channel/``.
+
+Usage::
+
+    with InputNode() as inp:
+        dag = stage2.forward.bind(stage1.forward.bind(inp))
+    compiled = dag.experimental_compile()
+    out = compiled.execute(x).get()
+    compiled.teardown()
+"""
+
+from ray_tpu.dag.channel import ChannelClosedError, ChannelTimeoutError, ShmChannel
+from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.node import (
+    ActorClassNode,
+    ActorMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "ActorClassNode",
+    "ActorMethodNode",
+    "ChannelClosedError",
+    "ChannelTimeoutError",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "DAGNode",
+    "FunctionNode",
+    "InputAttributeNode",
+    "InputNode",
+    "MultiOutputNode",
+    "ShmChannel",
+]

@@ -1,0 +1,570 @@
+"""Compiled execution of actor DAGs over mutable shm channels.
+
+Reference: ``python/ray/dag/compiled_dag_node.py`` — ``CompiledDAG``
+(``:135``), per-actor ``ExecutableTask`` loops (``:349``, ``:668``), the
+driver proxy (``:679``) and ``execute`` (``:2065``). A static DAG of actor
+method calls is compiled ONCE into: (a) a set of ring-buffer shm channels
+(``channel.py``), one per cross-process edge, and (b) one long-running
+loop per actor that reads its input channels, runs the bound methods, and
+writes its outputs — so steady-state executions cost shm memcpys and
+version bumps, with no RPC, no task submission, and no object store on
+the hot path.
+
+TPU mapping (SURVEY §5.8): shm channels are unchanged from the reference
+design; the GPU NCCL channel (``torch_tensor_nccl_channel.py``) has NO
+analogue here because on TPU device-to-device movement belongs to XLA
+collectives inside one jitted program (``parallel/``) — a compiled actor
+pipeline stages host arrays through shm and each actor re-uploads to its
+own chip, which is the correct topology for PP-style serving where stages
+own disjoint devices.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.dag.channel import (
+    KIND_CLOSE,
+    KIND_ERROR,
+    KIND_VALUE,
+    ChannelClosedError,
+    ShmChannel,
+)
+from ray_tpu.dag.node import (
+    ActorMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+logger = logging.getLogger(__name__)
+
+DAG_LOOP_METHOD = "__ray_dag_loop__"
+
+
+# ---------------------------------------------------------------------------
+# classic (uncompiled) execution
+
+
+def execute_classic(root: DAGNode, args: Tuple, kwargs: Dict):
+    """One ``.remote()`` per node; ObjectRefs flow as arguments so the
+    runtime's normal dependency machinery does the rest."""
+    memo: Dict[int, Any] = {}
+
+    def resolve(node):
+        if not isinstance(node, DAGNode):
+            return node
+        key = id(node)
+        if key in memo:
+            return memo[key]
+        if isinstance(node, InputNode):
+            if kwargs or len(args) != 1:
+                raise ValueError(
+                    "multi-arg DAG input requires accessors (inp[i] / inp.key)"
+                )
+            out = args[0]
+        elif isinstance(node, InputAttributeNode):
+            out = (
+                args[node.key]
+                if isinstance(node.key, int)
+                else kwargs[node.key]
+            )
+        elif isinstance(node, MultiOutputNode):
+            out = [resolve(o) for o in node.outputs]
+        elif isinstance(node, FunctionNode):
+            rargs = [resolve(a) for a in node.args]
+            rkwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+            out = node.remote_fn.remote(*rargs, **rkwargs)
+        elif isinstance(node, ActorMethodNode):
+            rargs = [resolve(a) for a in node.args]
+            rkwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+            out = getattr(node.handle, node.method_name).remote(*rargs, **rkwargs)
+        else:
+            raise TypeError(f"cannot execute node type {type(node).__name__}")
+        memo[key] = out
+        return out
+
+    try:
+        return resolve(root)
+    finally:
+        # Break the recursive closure's self-cycle (cell → resolve →
+        # cell): left intact it pins the node graph — and the actor
+        # HANDLES inside it — until a generational GC pass, deferring
+        # handle-drop actor reclamation unboundedly.
+        resolve = None
+
+
+# ---------------------------------------------------------------------------
+# compiled execution
+
+
+class CompiledDAGRef:
+    """Result handle for one ``execute()`` (reference ``CompiledDAGRef``).
+    Results must be retrieved via :meth:`get` (or ``ray_tpu.get``)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._consumed = False
+
+    def get(self, timeout: Optional[float] = None):
+        if self._consumed:
+            raise ValueError("CompiledDAGRef results can only be retrieved once")
+        self._consumed = True
+        return self._dag._get_result(self._seq, timeout)
+
+    def __del__(self):
+        # a dropped, never-got ref must not pin its cached result forever
+        if not getattr(self, "_consumed", True):
+            try:
+                self._dag._discard_result(self._seq)
+            except Exception:
+                pass
+
+
+class _ChannelSpec:
+    __slots__ = ("name", "slot_size", "num_slots", "readers")
+
+    def __init__(self, name, slot_size, num_slots):
+        self.name = name
+        self.slot_size = slot_size
+        self.num_slots = num_slots
+        self.readers: List[Any] = []  # consumer identities (actor_id bytes | "driver")
+
+    def reader_idx(self, who) -> int:
+        return self.readers.index(who)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "slot_size": self.slot_size,
+            "num_slots": self.num_slots,
+            "num_readers": max(1, len(self.readers)),
+        }
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, *, buffer_size_bytes: int, max_inflight: int, timeout_s: float):
+        self._root = root
+        self._buffer = buffer_size_bytes
+        self._slots = max(2, max_inflight)
+        self._timeout = timeout_s
+        self._seq = 0
+        self._next_get = 0
+        self._result_cache: Dict[int, Any] = {}
+        self._discarded: set = set()
+        self._lock = threading.Lock()
+        self._exec_lock = threading.Lock()  # serializes input writes
+        self._torn_down = False
+        self._compile()
+
+    # -- compilation -----------------------------------------------------
+    def _compile(self) -> None:
+        outputs = (
+            self._root.outputs if isinstance(self._root, MultiOutputNode) else [self._root]
+        )
+        # topo order over the actor-method nodes
+        order: List[DAGNode] = []
+        seen: Dict[int, bool] = {}
+        has_input = False
+
+        def visit(node: DAGNode):
+            nonlocal has_input
+            if id(node) in seen:
+                return
+            seen[id(node)] = True
+            if isinstance(node, (InputNode, InputAttributeNode)):
+                has_input = True
+                return
+            if isinstance(node, FunctionNode):
+                raise ValueError(
+                    "compiled graphs support actor methods only; "
+                    "fn.bind(...) nodes require classic execute()"
+                )
+            if not isinstance(node, ActorMethodNode):
+                raise TypeError(f"cannot compile node type {type(node).__name__}")
+            for up in node._upstream():
+                visit(up)
+            order.append(node)
+
+        try:
+            for out in outputs:
+                if isinstance(out, (InputNode, InputAttributeNode)):
+                    raise ValueError("a compiled DAG output must be an actor method")
+                visit(out)
+        finally:
+            visit = None  # break the recursive closure's self-cycle
+        if not has_input:
+            raise ValueError("compiled DAGs must consume an InputNode")
+
+        # pid in the name lets the daemon's orphan sweep reap channels
+        # (and their sem.* wakeup files) of crashed drivers
+        import os
+
+        run_id = f"{os.getpid()}-{uuid.uuid4().hex[:10]}"
+        self._input_chan_spec = _ChannelSpec(f"rt-chan-{run_id}-in", self._buffer, self._slots)
+        chan_of: Dict[int, _ChannelSpec] = {}  # producing node id -> channel
+        n_chan = 0
+
+        def actor_of(node: ActorMethodNode):
+            return node.handle.actor_id.binary()
+
+        # a node needs a channel iff some consumer lives in another process
+        consumers: Dict[int, List[Any]] = {id(n): [] for n in order}
+        for node in order:
+            for up in node._upstream():
+                if isinstance(up, ActorMethodNode):
+                    consumers[id(up)].append(actor_of(node))
+                elif isinstance(up, (InputNode, InputAttributeNode)):
+                    if actor_of(node) not in self._input_chan_spec.readers:
+                        self._input_chan_spec.readers.append(actor_of(node))
+        for out in outputs:
+            consumers[id(out)].append("driver")
+
+        for node in order:
+            remote = [c for c in consumers[id(node)] if c != actor_of(node)]
+            if remote:
+                spec = _ChannelSpec(f"rt-chan-{run_id}-{n_chan}", self._buffer, self._slots)
+                n_chan += 1
+                for c in remote:
+                    if c not in spec.readers:
+                        spec.readers.append(c)
+                chan_of[id(node)] = spec
+
+        # build per-actor plans
+        plans: Dict[bytes, Dict[str, Any]] = {}
+        local_ids: Dict[int, int] = {}
+        for i, node in enumerate(order):
+            local_ids[id(node)] = i
+        for node in order:
+            aid = actor_of(node)
+            plan = plans.setdefault(aid, {"ops": [], "chans": {}})
+
+            def argspec(a):
+                if isinstance(a, (InputNode, InputAttributeNode)):
+                    spec = self._input_chan_spec
+                    d = spec.as_dict()
+                    d["reader_idx"] = spec.reader_idx(aid)
+                    plan["chans"][spec.name] = d
+                    key = a.key if isinstance(a, InputAttributeNode) else None
+                    return ("chan", spec.name, key)
+                if isinstance(a, ActorMethodNode):
+                    if actor_of(a) == aid:
+                        return ("local", local_ids[id(a)])
+                    spec = chan_of[id(a)]
+                    d = spec.as_dict()
+                    d["reader_idx"] = spec.reader_idx(aid)
+                    plan["chans"][spec.name] = d
+                    return ("chan", spec.name, None)
+                if isinstance(a, DAGNode):
+                    raise TypeError(f"unsupported arg node {type(a).__name__}")
+                return ("const", pickle.dumps(a))
+
+            out_spec = chan_of.get(id(node))
+            plan["ops"].append(
+                {
+                    "method": node.method_name,
+                    "args": [argspec(a) for a in node.args],
+                    "kwargs": {k: argspec(v) for k, v in node.kwargs.items()},
+                    "local_id": local_ids[id(node)],
+                    "out": out_spec.as_dict() if out_spec else None,
+                }
+            )
+
+        # driver-side channel objects (create them all here — actors attach)
+        self._input_chan = ShmChannel(
+            self._input_chan_spec.name,
+            create=True,
+            slot_size=self._buffer,
+            num_slots=self._slots,
+            num_readers=max(1, len(self._input_chan_spec.readers)),
+        )
+        self._all_chans: List[ShmChannel] = [self._input_chan]
+        self._out_readers: List[Tuple[ShmChannel, int]] = []
+        created: Dict[str, ShmChannel] = {self._input_chan_spec.name: self._input_chan}
+        for node in order:
+            spec = chan_of.get(id(node))
+            if spec is None:
+                continue
+            ch = ShmChannel(
+                spec.name,
+                create=True,
+                slot_size=spec.slot_size,
+                num_slots=spec.num_slots,
+                num_readers=max(1, len(spec.readers)),
+            )
+            created[spec.name] = ch
+            self._all_chans.append(ch)
+        for out in outputs:
+            spec = chan_of[id(out)]
+            self._out_readers.append((created[spec.name], spec.reader_idx("driver")))
+        self._multi = isinstance(self._root, MultiOutputNode)
+
+        # launch the loops (one long-running actor task per actor)
+        self._loop_refs = []
+        self._handles = {}
+        for node in order:
+            aid = actor_of(node)
+            self._handles[aid] = node.handle
+        for aid, plan in plans.items():
+            self._loop_refs.append(self._submit_loop(self._handles[aid], plan))
+
+    def _submit_loop(self, handle, plan):
+        from ray_tpu.core.actor import ActorMethod
+
+        return ActorMethod(handle, DAG_LOOP_METHOD, {}).remote(plan)
+
+    # -- execution -------------------------------------------------------
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        from ray_tpu.core import serialization
+
+        payload = serialization.serialize((args, kwargs)).to_bytes()
+        # The seq is committed only once the write SUCCEEDS (the lock
+        # covers both): a failed write (oversized value, backpressure
+        # timeout) must not leave a hole in the strictly-sequential
+        # stream — the loops would wait on that slot forever.
+        with self._exec_lock:
+            with self._lock:
+                if self._torn_down:
+                    raise RuntimeError("this compiled DAG has been torn down")
+                seq = self._seq
+            self._input_chan.write(seq, KIND_VALUE, payload, timeout=self._timeout)
+            with self._lock:
+                self._seq += 1
+        return CompiledDAGRef(self, seq)
+
+    def _discard_result(self, seq: int) -> None:
+        with self._lock:
+            if seq < self._next_get:
+                self._result_cache.pop(seq, None)
+            else:
+                self._discarded.add(seq)
+
+    def _get_result(self, seq: int, timeout: Optional[float]):
+        timeout = self._timeout if timeout is None else timeout
+        with self._lock:
+            while self._next_get <= seq:
+                cur = self._next_get
+                outs: List[Any] = []
+                err: Optional[BaseException] = None
+                raw: List[Any] = []
+                for ch, ridx in self._out_readers:
+                    kind, view = ch.read(ridx, cur, timeout)
+                    # copy BEFORE advancing: the decoded value would
+                    # otherwise alias the slot, which the writer may
+                    # overwrite once the cursor moves
+                    raw.append((kind, bytes(view)))
+                for ch, ridx in self._out_readers:
+                    ch.advance(ridx, cur)
+                from ray_tpu.core import serialization
+
+                for kind, data in raw:
+                    if kind == KIND_CLOSE:
+                        raise ChannelClosedError("compiled DAG torn down")
+                    if kind == KIND_ERROR:
+                        e = pickle.loads(data)
+                        err = err or e
+                        outs.append(e)
+                    else:
+                        outs.append(serialization.deserialize_bytes(data))
+                if cur in self._discarded:
+                    self._discarded.discard(cur)
+                else:
+                    self._result_cache[cur] = err if err is not None else (
+                        outs if self._multi else outs[0]
+                    )
+                self._next_get = cur + 1
+            result = self._result_cache.pop(seq)
+        if isinstance(result, BaseException):
+            raise result
+        return result
+
+    # -- teardown --------------------------------------------------------
+    def teardown(self) -> None:
+        with self._exec_lock:
+            with self._lock:
+                if self._torn_down:
+                    return
+                self._torn_down = True
+                seq = self._seq
+                self._seq += 1
+            try:
+                self._input_chan.write_close(seq, timeout=self._timeout)
+            except Exception:
+                logger.debug("close write failed during teardown", exc_info=True)
+        import ray_tpu
+
+        for ref in self._loop_refs:
+            try:
+                ray_tpu.get(ref, timeout=self._timeout)
+            except Exception:
+                logger.debug("loop did not exit cleanly", exc_info=True)
+        for ch in self._all_chans:
+            ch.unlink()
+            ch.close()
+        # drop graph/handle references NOW: actor reclamation is driven by
+        # handle refcounts, and a compiled dag must not pin its actors
+        # past teardown
+        self._root = None
+        self._handles = {}
+        self._loop_refs = []
+        self._out_readers = []
+        self._all_chans = []
+
+    def __del__(self):
+        try:
+            if not self._torn_down:
+                self.teardown()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# worker-side loop (runs inside the actor's execution lane)
+
+
+import os as _os
+
+
+def _chan_alive(ch: ShmChannel) -> bool:
+    return _os.path.exists("/dev/shm/" + ch.name)
+
+
+def _read_live(ch: ShmChannel, reader: int, seq: int):
+    """Read with a liveness check: a blocked read must notice when the
+    driver unlinked the channel (teardown after abandoning results, or a
+    crashed driver) instead of pinning the actor's lane forever."""
+    from ray_tpu.dag.channel import ChannelTimeoutError
+
+    while True:
+        try:
+            return ch.read(reader, seq, timeout=5.0)
+        except ChannelTimeoutError:
+            if not _chan_alive(ch):
+                raise ChannelClosedError(f"channel {ch.name} unlinked")
+
+
+def _write_live(write_fn, ch: ShmChannel, *args) -> None:
+    """Write with the same liveness rule (ring backpressure against a
+    gone driver must not wedge the loop)."""
+    from ray_tpu.dag.channel import ChannelTimeoutError
+
+    while True:
+        try:
+            return write_fn(*args, timeout=5.0)
+        except ChannelTimeoutError:
+            if not _chan_alive(ch):
+                raise ChannelClosedError(f"channel {ch.name} unlinked")
+
+
+def run_dag_loop(actor_instance, plan: Dict[str, Any]) -> None:
+    """The compiled per-actor loop (reference ``ExecutableTask`` loops,
+    ``compiled_dag_node.py:668``): attach channels once, then read →
+    compute → write until a CLOSE marker cascades through."""
+    chans: Dict[str, ShmChannel] = {}
+    reader_idx: Dict[str, int] = {}
+    for name, d in plan["chans"].items():
+        chans[name] = ShmChannel(name)
+        reader_idx[name] = d["reader_idx"]
+    out_chans: Dict[str, ShmChannel] = {}
+    for op in plan["ops"]:
+        if op["out"] is not None and op["out"]["name"] not in out_chans:
+            out_chans[op["out"]["name"]] = ShmChannel(op["out"]["name"])
+    consts: Dict[int, Any] = {}
+
+    from ray_tpu.core import serialization
+
+    seq = 0
+    try:
+        while True:
+            # read every input channel once for this seq
+            views: Dict[str, Tuple[int, Any]] = {}
+            closing = False
+            for name, ch in chans.items():
+                kind, view = _read_live(ch, reader_idx[name], seq)
+                views[name] = (kind, view)
+                if kind == KIND_CLOSE:
+                    closing = True
+            if closing:
+                for ch in out_chans.values():
+                    try:
+                        ch.write(seq, KIND_CLOSE, b"", timeout=5)
+                    except Exception:
+                        pass
+                return
+            error: Optional[BaseException] = None
+            local_vals: Dict[int, Any] = {}
+            decoded: Dict[str, Any] = {}
+            plan_input_name = next(
+                (n for n in plan["chans"] if n.endswith("-in")), None
+            )
+
+            def resolve(spec):
+                kind = spec[0]
+                if kind == "const":
+                    key = id(spec[1])
+                    if key not in consts:
+                        consts[key] = pickle.loads(spec[1])
+                    return consts[key]
+                if kind == "local":
+                    return local_vals[spec[1]]
+                # ("chan", name, accessor)
+                _, name, accessor = spec
+                if name not in decoded:
+                    k, view = views[name]
+                    if k == KIND_ERROR:
+                        raise pickle.loads(view)
+                    decoded[name] = serialization.deserialize_bytes(view)
+                value = decoded[name]
+                if name == plan_input_name:
+                    in_args, in_kwargs = value
+                    if accessor is None:
+                        if in_kwargs or len(in_args) != 1:
+                            raise ValueError(
+                                "multi-arg input consumed without accessor"
+                            )
+                        return in_args[0]
+                    if isinstance(accessor, int):
+                        return in_args[accessor]
+                    return in_kwargs[accessor]
+                return value
+
+            for op in plan["ops"]:
+                try:
+                    if error is not None:
+                        raise error
+                    args = [resolve(s) for s in op["args"]]
+                    kwargs = {k: resolve(s) for k, s in op["kwargs"].items()}
+                    result = getattr(actor_instance, op["method"])(*args, **kwargs)
+                    local_vals[op["local_id"]] = result
+                    if op["out"] is not None:
+                        ch = out_chans[op["out"]["name"]]
+                        _write_live(ch.write_value, ch, seq, result)
+                except ChannelClosedError:
+                    return  # driver gone / torn down: exit the loop
+                except BaseException as e:  # noqa: BLE001 — propagate per-seq
+                    error = error or e
+                    if op["out"] is not None:
+                        try:
+                            ch = out_chans[op["out"]["name"]]
+                            _write_live(ch.write_error, ch, seq, e)
+                        except ChannelClosedError:
+                            return
+                        except Exception:
+                            pass
+            # consume AFTER compute: slot views must stay valid while the
+            # methods run (zero-copy reads)
+            for name, ch in chans.items():
+                ch.advance(reader_idx[name], seq)
+            seq += 1
+    except ChannelClosedError:
+        return  # teardown unlinked the channels / driver died
+    finally:
+        for ch in list(chans.values()) + list(out_chans.values()):
+            ch.close()

@@ -1,0 +1,151 @@
+"""DAG node types and classic (uncompiled) execution.
+
+Reference: ``python/ray/dag/dag_node.py`` + ``input_node.py`` — lazy call
+graphs built with ``.bind(...)``, executed either eagerly (every node one
+``.remote()`` call) or compiled into per-actor loops over mutable shm
+channels (``compiled_dag_node.py:135``; see ``compiled.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    """Base: a lazily-bound call in the graph."""
+
+    def execute(self, *args, **kwargs):
+        """Classic execution: walk the DAG, one ``.remote()`` per node,
+        returning an ObjectRef (or list for MultiOutputNode)."""
+        from ray_tpu.dag.compiled import execute_classic
+
+        return execute_classic(self, args, kwargs)
+
+    def experimental_compile(
+        self,
+        *,
+        _buffer_size_bytes: int = 1 << 20,
+        _max_inflight_executions: int = 8,
+        _timeout_s: float = 30.0,
+    ):
+        """Compile into per-actor loops over shm channels
+        (reference ``dag_node.experimental_compile``)."""
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(
+            self,
+            buffer_size_bytes=_buffer_size_bytes,
+            max_inflight=_max_inflight_executions,
+            timeout_s=_timeout_s,
+        )
+
+    # traversal
+    def _upstream(self) -> List["DAGNode"]:
+        return [a for a in getattr(self, "args", ()) if isinstance(a, DAGNode)] + [
+            v for v in getattr(self, "kwargs", {}).values() if isinstance(v, DAGNode)
+        ]
+
+
+class InputNode(DAGNode):
+    """The driver-provided input. Usable as a context manager
+    (``with InputNode() as inp``) for reference parity; attribute/item
+    access returns accessor nodes for multi-arg inputs."""
+
+    _local = threading.local()
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def __getitem__(self, key) -> "InputAttributeNode":
+        return InputAttributeNode(self, key)
+
+    def __getattr__(self, name: str) -> "InputAttributeNode":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return InputAttributeNode(self, name)
+
+
+class InputAttributeNode(DAGNode):
+    """``inp[i]`` / ``inp.key`` — selects one piece of a multi-part input."""
+
+    def __init__(self, parent: InputNode, key):
+        self.parent = parent
+        self.key = key
+
+    def _upstream(self) -> List[DAGNode]:
+        return [self.parent]
+
+
+class ActorMethodNode(DAGNode):
+    """A bound actor method call (``actor.method.bind(...)``)."""
+
+    def __init__(self, handle, method_name: str, args: Tuple, kwargs: Dict, opts: Dict):
+        self.handle = handle
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+        self.opts = opts
+
+
+class FunctionNode(DAGNode):
+    """A bound remote function (``fn.bind(...)``) — supported in classic
+    execution; compiled graphs require actor methods (loops need a
+    process to live in; reference has the same restriction)."""
+
+    def __init__(self, remote_fn, args: Tuple, kwargs: Dict):
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+
+
+class ActorClassNode(DAGNode):
+    """``Cls.bind(...)`` — a DAG-owned actor, instantiated on first use.
+    Only literal constructor args are supported."""
+
+    def __init__(self, actor_cls, args: Tuple, kwargs: Dict):
+        self.actor_cls = actor_cls
+        self.args = args
+        self.kwargs = kwargs
+        self._handle = None
+        self._lock = threading.Lock()
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, DAGNode):
+                raise ValueError(
+                    "ActorClassNode constructor args must be literals"
+                )
+
+    def get_handle(self):
+        with self._lock:
+            if self._handle is None:
+                self._handle = self.actor_cls.remote(*self.args, **self.kwargs)
+            return self._handle
+
+    def __getattr__(self, name: str):
+        if name.startswith("_") or name in ("actor_cls", "args", "kwargs", "get_handle"):
+            raise AttributeError(name)
+
+        class _BoundMethod:
+            def __init__(inner, outer, method):
+                inner.outer = outer
+                inner.method = method
+
+            def bind(inner, *args, **kwargs):
+                handle = inner.outer.get_handle()
+                return getattr(handle, inner.method).bind(*args, **kwargs)
+
+        return _BoundMethod(self, name)
+
+
+class MultiOutputNode(DAGNode):
+    """Bundles several terminal nodes; execute/compile return one value
+    per output (reference ``ray.dag.MultiOutputNode``)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        self.outputs = list(outputs)
+
+    def _upstream(self) -> List[DAGNode]:
+        return list(self.outputs)
