@@ -5,6 +5,17 @@ Reference: ``python/ray/data/`` (Dataset / read_api / streaming executor
 
 from ray_tpu.data.block import Block, VALUE_COL
 from ray_tpu.data.dataset import Dataset, DataShard
+from ray_tpu.data.executor import ActorPoolStrategy
+from ray_tpu.data.grouped import (
+    AggregateFn,
+    Count,
+    GroupedData,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+)
 from ray_tpu.data.read_api import (
     from_arrow,
     from_items,
@@ -20,7 +31,16 @@ from ray_tpu.data.read_api import (
 range = range_  # noqa: A001
 
 __all__ = [
+    "ActorPoolStrategy",
+    "AggregateFn",
     "Block",
+    "Count",
+    "GroupedData",
+    "Max",
+    "Mean",
+    "Min",
+    "Std",
+    "Sum",
     "VALUE_COL",
     "Dataset",
     "DataShard",

@@ -40,10 +40,23 @@ def block_slice(block: Block, start: int, end: int) -> Block:
 
 
 def block_concat(blocks: Sequence[Block]) -> Block:
+    blocks = [b for b in blocks if b]  # empty ({}) blocks contribute nothing
+    if not blocks:
+        return {}
     if len(blocks) == 1:
         return blocks[0]
     keys = blocks[0].keys()
     return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def apply_batched(fn, block: Block, batch_size: int) -> Block:
+    """Run ``fn`` over ``batch_size``-row slices of a block and concat
+    the outputs (shared by Dataset.map_batches and the actor pool)."""
+    outs = []
+    n = block_num_rows(block)
+    for s in range(0, n, batch_size):
+        outs.append(normalize_block(fn(block_slice(block, s, min(n, s + batch_size)))))
+    return block_concat(outs) if outs else block
 
 
 def block_take(block: Block, indices: np.ndarray) -> Block:

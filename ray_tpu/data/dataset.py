@@ -38,45 +38,90 @@ from ray_tpu.data.block import (
     normalize_block,
     rows_of,
 )
-from ray_tpu.data.executor import Source, execute_all, execute_streaming
+from ray_tpu.data.executor import (
+    ActorPoolStrategy,
+    ActorStage,
+    FusedStage,
+    Source,
+    execute_pipeline,
+)
 from ray_tpu.data.iterator import iter_batches_from_refs, iter_device_batches
 
 DEFAULT_BLOCK_SIZE = 1024  # rows per block for in-memory sources
 
 
 class Dataset:
-    """Lazy dataset: construct via ``ray_tpu.data.from_items/range/read_*``."""
+    """Lazy dataset: construct via ``ray_tpu.data.from_items/range/read_*``.
 
-    def __init__(self, sources: Sequence[Source], transforms=None):
+    The plan is (sources, stages): consecutive map-like transforms fuse
+    into one task per block (FusedStage); stateful ``map_batches`` with
+    ``compute=ActorPoolStrategy(...)`` breaks fusion into an ActorStage
+    (reference: operator fusion rules + ActorPoolMapOperator)."""
+
+    def __init__(self, sources: Sequence[Source], stages=None):
         self._sources: List[Source] = list(sources)
-        self._transforms: List[Callable[[Block], Block]] = list(transforms or [])
+        self._stages: List[Any] = list(stages or [])
         self._materialized: Optional[List[Any]] = None  # block refs cache
 
+    # back-compat view used by a few internals/tests
+    @property
+    def _transforms(self) -> List[Callable[[Block], Block]]:
+        out: List[Callable[[Block], Block]] = []
+        for s in self._stages:
+            if isinstance(s, FusedStage):
+                out.extend(s.transforms)
+        return out
+
     # -- transforms (lazy, fused) ---------------------------------------
+    def _plan(self):
+        """(sources, stages) this dataset would execute."""
+        if self._materialized is not None:
+            return list(self._materialized), []
+        return self._sources, self._stages
+
     def _chain(self, t: Callable[[Block], Block]) -> "Dataset":
-        # A materialized dataset's refs become the new plan's sources, so
-        # transforms chained after shuffle/limit/etc. see the data.
-        sources = self._materialized if self._materialized is not None else self._sources
-        return Dataset(sources, self._transforms + [t] if self._materialized is None else [t])
+        sources, stages = self._plan()
+        if stages and isinstance(stages[-1], FusedStage):
+            stages = stages[:-1] + [stages[-1].chained(t)]
+        else:
+            stages = stages + [FusedStage([t])]
+        return Dataset(sources, stages)
 
     def map_batches(
         self,
-        fn: Callable[[Block], Any],
+        fn: Any,
         *,
         batch_size: Optional[int] = None,
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: Optional[Dict[str, Any]] = None,
     ) -> "Dataset":
         """Apply ``fn`` to whole blocks (optionally re-chunked to
-        ``batch_size`` rows inside the task)."""
+        ``batch_size`` rows inside the task). With
+        ``compute=ActorPoolStrategy(...)``, ``fn`` must be a CLASS —
+        constructed once per pool actor (expensive state like a loaded
+        model amortizes across blocks; reference ActorPoolMapOperator)."""
+        if compute is not None:
+            if not isinstance(fn, type):
+                raise ValueError(
+                    "compute=ActorPoolStrategy requires a callable CLASS"
+                )
+            sources, stages = self._plan()
+            return Dataset(
+                sources,
+                stages
+                + [
+                    ActorStage(
+                        fn, fn_constructor_args, fn_constructor_kwargs or {},
+                        compute, batch_size,
+                    )
+                ],
+            )
         if batch_size is None:
             return self._chain(lambda b: normalize_block(fn(b)))
+        from ray_tpu.data.block import apply_batched
 
-        def rechunked(block: Block) -> Block:
-            outs = []
-            n = block_num_rows(block)
-            for s in range(0, n, batch_size):
-                outs.append(normalize_block(fn(block_slice(block, s, min(n, s + batch_size)))))
-            return block_concat(outs) if outs else block
-        return self._chain(rechunked)
+        return self._chain(lambda b: apply_batched(fn, b, batch_size))
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         def per_row(block: Block) -> Block:
@@ -102,13 +147,15 @@ class Dataset:
     # -- execution -------------------------------------------------------
     def _block_refs(self) -> List[Any]:
         if self._materialized is None:
-            self._materialized = execute_all(self._sources, self._transforms)
+            self._materialized = list(
+                execute_pipeline(self._sources, self._stages)
+            )
         return self._materialized
 
     def _stream_refs(self) -> Iterator[Any]:
         if self._materialized is not None:
             return iter(self._materialized)
-        return execute_streaming(self._sources, self._transforms)
+        return execute_pipeline(self._sources, self._stages)
 
     def materialize(self) -> "Dataset":
         self._block_refs()
@@ -143,6 +190,55 @@ class Dataset:
         return _from_blocks(
             [block_slice(merged, s, min(n, s + per)) for s in range(0, n, per)]
         )
+
+    def groupby(self, key: str):
+        """Group by a column (reference ``Dataset.groupby`` →
+        ``GroupedData``): distributed partial-aggregate + hash shuffle."""
+        from ray_tpu.data.grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Global sample-sort by a column (reference ``Dataset.sort``)."""
+        from ray_tpu.data.grouped import sort_dataset
+
+        return sort_dataset(self, key, descending)
+
+    def unique(self, column: str) -> List[Any]:
+        vals = set()
+        for ref in self._stream_refs():
+            b = ray_tpu.get(ref, timeout=600)
+            vals.update(np.unique(b[column]).tolist())
+        return sorted(vals)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two equal-length datasets (reference
+        ``Dataset.zip``; right-side name collisions get a ``_1``
+        suffix)."""
+        left = [ray_tpu.get(r, timeout=600) for r in self._block_refs()]
+        right = [ray_tpu.get(r, timeout=600) for r in other._block_refs()]
+        lm = block_concat(left) if left else {}
+        rm = block_concat(right) if right else {}
+        ln, rn = block_num_rows(lm), block_num_rows(rm)
+        if ln != rn:
+            raise ValueError(f"zip() requires equal row counts ({ln} vs {rn})")
+        out = dict(lm)
+        for k, v in rm.items():
+            out[k if k not in out else f"{k}_1"] = v
+        per = max(1, ln // max(1, len(left) or 1))
+        return _from_blocks(
+            [block_slice(out, s, min(ln, s + per)) for s in range(0, ln, per)]
+        )
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (reference ``Dataset.union``): each
+        side's plan executes independently; blocks chain in order."""
+        refs: List[Any] = list(self._block_refs())
+        for o in others:
+            refs.extend(o._block_refs())
+        ds = Dataset(refs)
+        ds._materialized = list(refs)
+        return ds
 
     def limit(self, n: int) -> "Dataset":
         taken: List[Block] = []
@@ -242,14 +338,13 @@ class Dataset:
                 DataShard(p._materialized or p._sources, [], i, n)
                 for i, p in enumerate(parts)
             ]
-        sources = self._materialized if self._materialized is not None else self._sources
-        transforms = [] if self._materialized is not None else self._transforms
-        return [DataShard(sources[i::n], transforms, i, n) for i in range(n)]
+        sources, stages = self._plan()
+        return [DataShard(sources[i::n], stages, i, n) for i in range(n)]
 
     def __repr__(self) -> str:
         return (
             f"Dataset(blocks={self.num_blocks()}, "
-            f"transforms={len(self._transforms)})"
+            f"stages={len(self._stages)})"
         )
 
 
@@ -265,15 +360,15 @@ class DataShard(Dataset):
     read callables or ObjectRefs), re-iterable every epoch, executed by
     whichever worker consumes it."""
 
-    def __init__(self, sources, transforms, split_idx: int, num_splits: int):
-        super().__init__(sources, transforms)
+    def __init__(self, sources, stages, split_idx: int, num_splits: int):
+        super().__init__(sources, stages)
         self._idx = split_idx
         self._n = num_splits
 
     def __reduce__(self):
         return (
             DataShard,
-            (self._sources, self._transforms, self._idx, self._n),
+            (self._sources, self._stages, self._idx, self._n),
         )
 
     def __repr__(self) -> str:

@@ -181,3 +181,100 @@ def test_early_abandonment_stops_prefetch(cluster):
     _t.sleep(1.0)  # let producer threads observe the stop flag
     after = threading.active_count()
     assert after - before < 5, (before, after)
+
+
+# ---------------------------------------------------------------------------
+# groupby / aggregate / sort / zip / union (reference grouped_data.py)
+
+
+def test_groupby_wordcount(cluster):
+    words = ["a", "b", "a", "c", "b", "a", "c", "a", "b", "c", "d"]
+    ds = rd.from_items([{"word": w} for w in words])
+    out = ds.groupby("word").count().take_all()
+    counts = {r["word"]: int(r["count()"]) for r in out}
+    assert counts == {"a": 4, "b": 3, "c": 3, "d": 1}
+
+
+def test_groupby_aggregates(cluster):
+    rows = [{"k": i % 3, "v": float(i)} for i in range(30)]
+    ds = rd.from_items(rows)
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums == {
+        0: sum(float(i) for i in range(0, 30, 3)),
+        1: sum(float(i) for i in range(1, 30, 3)),
+        2: sum(float(i) for i in range(2, 30, 3)),
+    }
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    assert abs(means[0] - 13.5) < 1e-9
+    mins = {r["k"]: r["min(v)"] for r in ds.groupby("k").min("v").take_all()}
+    assert mins == {0: 0.0, 1: 1.0, 2: 2.0}
+    maxs = {r["k"]: r["max(v)"] for r in ds.groupby("k").max("v").take_all()}
+    assert maxs == {0: 27.0, 1: 28.0, 2: 29.0}
+
+
+def test_groupby_map_groups(cluster):
+    rows = [{"k": i % 2, "v": float(i)} for i in range(10)]
+    ds = rd.from_items(rows)
+
+    def summarize(group):
+        return {"k": group["k"][:1], "n": np.asarray([len(group["v"])])}
+
+    out = ds.groupby("k").map_groups(summarize).take_all()
+    assert {int(r["k"]): int(r["n"]) for r in out} == {0: 5, 1: 5}
+
+
+def test_sort(cluster):
+    import random
+
+    vals = list(range(200))
+    random.Random(7).shuffle(vals)
+    ds = rd.from_items([{"x": v} for v in vals])
+    out = [int(r["x"]) for r in ds.sort("x").take_all()]
+    assert out == sorted(vals)
+    out_desc = [int(r["x"]) for r in ds.sort("x", descending=True).take_all()]
+    assert out_desc == sorted(vals, reverse=True)
+
+
+def test_zip_and_union(cluster):
+    a = rd.from_items([{"x": i} for i in range(10)])
+    b = rd.from_items([{"y": i * 2} for i in range(10)])
+    z = a.zip(b).take_all()
+    assert all(int(r["y"]) == 2 * int(r["x"]) for r in z)
+    u = a.union(a)
+    assert u.count() == 20
+
+
+def test_actor_pool_map_batches(cluster):
+    """Stateful map on an actor pool: the class is constructed once per
+    pool actor (expensive state amortizes), not once per block."""
+
+    class AddOffset:
+        def __init__(self, offset):
+            self.offset = offset
+            self.calls = 0
+
+        def __call__(self, block):
+            self.calls += 1
+            return {"value": block["value"] + self.offset}
+
+    ds = rd.range(100, block_size=10).map_batches(
+        AddOffset,
+        compute=rd.ActorPoolStrategy(size=2),
+        fn_constructor_args=(1000,),
+    )
+    out = sorted(int(v) for b in ds.iter_batches(batch_size=None) for v in b["value"])
+    assert out == [i + 1000 for i in range(100)]
+
+
+def test_actor_pool_requires_class(cluster):
+    with pytest.raises(ValueError, match="callable CLASS"):
+        rd.range(10).map_batches(lambda b: b, compute=rd.ActorPoolStrategy(size=1))
+
+
+def test_groupby_multiblock_string_keys(cluster):
+    """Keys hashed in DIFFERENT worker processes must land in the same
+    partition (deterministic hash, not the process-salted builtin)."""
+    words = (["alpha"] * 7 + ["beta"] * 5 + ["gamma"] * 3) * 4
+    ds = rd.from_items([{"w": w} for w in words]).repartition(6)
+    out = {r["w"]: int(r["count()"]) for r in ds.groupby("w").count().take_all()}
+    assert out == {"alpha": 28, "beta": 20, "gamma": 12}
