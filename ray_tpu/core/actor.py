@@ -82,6 +82,7 @@ class ActorHandle:
         name: Optional[str] = None,
         namespace: Optional[str] = None,
         owned: bool = False,
+        max_concurrency: int = 1,
     ):
         self._actor_id = actor_id
         self._method_opts = method_opts
@@ -89,6 +90,10 @@ class ActorHandle:
         self._name = name
         self._namespace = namespace
         self._owned = owned
+        # carried in the handle so a BORROWER's first calls dispatch
+        # concurrently instead of serializing through the ordered pump
+        # until an actor-info round-trip resolves it
+        self._max_concurrency = max(1, max_concurrency)
         self._seq_lock = threading.Lock()
         self._seq_no = 0
 
@@ -148,6 +153,7 @@ class ActorHandle:
         )
         spec.seq_no = self._next_seq()
         spec.concurrency_group = opts.get("concurrency_group")
+        spec.max_concurrency = self._max_concurrency  # dispatch-path hint
         worker.backend.submit_actor_task(spec)
         refs = [ObjectRef(oid, worker.address) for oid in spec.return_ids]
         worker.backend.release_hold(spec.return_ids)
@@ -162,9 +168,22 @@ class ActorHandle:
         return self._submit_method("__ray_terminate__", (), {}, {})
 
     def __reduce__(self):
+        # Serializing a handle HANDS THE ACTOR OFF: without distributed
+        # handle refcounting, auto-reclaim on creator-handle drop would
+        # kill an actor another process is using (factory pattern). A
+        # shared actor's lifetime falls back to kill()/job end.
+        self._owned = False
         return (
             ActorHandle,
-            (self._actor_id, self._method_opts, self._owner, self._name, self._namespace),
+            (
+                self._actor_id,
+                self._method_opts,
+                self._owner,
+                self._name,
+                self._namespace,
+                False,
+                self._max_concurrency,
+            ),
         )
 
     def __repr__(self) -> str:
@@ -233,6 +252,7 @@ class ActorClass:
             name=opts.name,
             namespace=opts.namespace or worker.namespace,
             owned=opts.lifetime != "detached" and opts.name is None,
+            max_concurrency=opts.max_concurrency or 1,
         )
 
     def bind(self, *args, **kwargs):
@@ -251,8 +271,12 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     info = worker.backend.get_named_actor(name, namespace or worker.namespace)
     if info is None:
         raise ValueError(f"no actor named {name!r} in namespace {namespace!r}")
-    actor_id, method_opts, owner = info
-    return ActorHandle(actor_id, method_opts, owner, name=name, namespace=namespace)
+    actor_id, method_opts, owner = info[:3]
+    maxc = info[3] if len(info) > 3 else 1
+    return ActorHandle(
+        actor_id, method_opts, owner, name=name, namespace=namespace,
+        max_concurrency=maxc,
+    )
 
 
 def kill(actor_or_handle, *, no_restart: bool = True) -> None:

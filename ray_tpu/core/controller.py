@@ -677,6 +677,7 @@ class Controller:
             "actor_id": actor_id,
             "method_opts": info.spec.method_opts,
             "owner": info.spec.owner,
+            "max_concurrency": info.spec.max_concurrency,
         }
 
     async def c_list_named_actors(self, payload, conn):

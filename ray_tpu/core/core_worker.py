@@ -1176,6 +1176,10 @@ class CoreWorker(RuntimeBackend):
         Must run on the io loop."""
         with self._actors_lock:
             st = self._actors.setdefault(spec.actor_id, _ActorState())
+            # handle-carried hint: a borrower's first dispatch must not
+            # serialize a concurrent actor through the ordered pump
+            if spec.max_concurrency > st.max_concurrency:
+                st.max_concurrency = spec.max_concurrency
         if st.max_concurrency > 1:
             asyncio.ensure_future(self._submit_actor(spec))
             return
@@ -1436,7 +1440,12 @@ class CoreWorker(RuntimeBackend):
         )
         if info is None:
             return None
-        return (info["actor_id"], info["method_opts"], info["owner"])
+        return (
+            info["actor_id"],
+            info["method_opts"],
+            info["owner"],
+            info.get("max_concurrency", 1),
+        )
 
     def list_named_actors(self, all_namespaces: bool):
         return self.io.run(

@@ -63,15 +63,6 @@ class Dataset:
         self._stages: List[Any] = list(stages or [])
         self._materialized: Optional[List[Any]] = None  # block refs cache
 
-    # back-compat view used by a few internals/tests
-    @property
-    def _transforms(self) -> List[Callable[[Block], Block]]:
-        out: List[Callable[[Block], Block]] = []
-        for s in self._stages:
-            if isinstance(s, FusedStage):
-                out.extend(s.transforms)
-        return out
-
     # -- transforms (lazy, fused) ---------------------------------------
     def _plan(self):
         """(sources, stages) this dataset would execute."""

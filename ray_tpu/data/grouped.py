@@ -170,35 +170,40 @@ def _group_reduce_task(part_idx: int, key: str, aggs: List[AggregateFn], *map_ou
     return out
 
 
-def _group_rows_task(part_idx: int, key: str, num_parts: int, *blocks):
-    """map_groups support: collect this partition's raw rows per key."""
-    rows_by_key: Dict[Any, List[Block]] = {}
-    for b in blocks:
-        b = normalize_block(b)
-        keys = b[key]
-        if len(keys) == 0:
-            continue
-        order = np.argsort(keys, kind="stable")
-        sb = block_take(b, order)
-        sk = sb[key]
-        bounds = np.flatnonzero(sk[1:] != sk[:-1]) + 1
-        starts = np.concatenate([[0], bounds])
-        ends = np.concatenate([bounds, [len(sk)]])
-        for s, e in zip(starts, ends):
-            kv = sk[s]
-            kv_py = kv.item() if hasattr(kv, "item") else kv
-            if _det_hash(kv_py) % num_parts != part_idx:
-                continue
-            rows_by_key.setdefault(kv_py, []).append({c: v[s:e] for c, v in sb.items()})
-    return {kv: block_concat(bs) for kv, bs in rows_by_key.items()}
+def _group_rows_partition_task(block: Block, key: str, num_parts: int):
+    """Hash-partition one block's raw rows by key. num_parts RETURN
+    VALUES (one ObjectRef per partition), so each reducer fetches only
+    its own partition instead of every map output."""
+    b = normalize_block(block)
+    keys = b[key]
+    if len(keys) == 0:
+        empty = [{} for _ in range(num_parts)]
+        return empty if num_parts > 1 else empty[0]
+    hashes = np.asarray(
+        [
+            _det_hash(k.item() if hasattr(k, "item") else k) % num_parts
+            for k in keys
+        ]
+    )
+    parts = [block_take(b, np.nonzero(hashes == p)[0]) for p in range(num_parts)]
+    return parts if num_parts > 1 else parts[0]
 
 
-def _map_groups_task(groups: Dict[Any, Block], fn) -> Block:
-    outs = []
-    for kv in sorted(groups.keys()):
-        outs.append(normalize_block(fn(groups[kv])))
-    if not outs:
+def _map_groups_reduce_task(key: str, fn, *part_blocks):
+    """Concat this partition's rows across blocks, group by key, apply
+    ``fn`` per group."""
+    merged = block_concat([normalize_block(p) for p in part_blocks if p])
+    if not merged or len(merged.get(key, ())) == 0:
         return {}
+    order = np.argsort(merged[key], kind="stable")
+    sb = block_take(merged, order)
+    sk = sb[key]
+    bounds = np.flatnonzero(sk[1:] != sk[:-1]) + 1
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [len(sk)]])
+    outs = []
+    for s, e in zip(starts, ends):
+        outs.append(normalize_block(fn({c: v[s:e] for c, v in sb.items()})))
     return block_concat(outs)
 
 
@@ -259,10 +264,17 @@ class GroupedData:
         if not refs:
             return Dataset([])
         R = self._num_parts(len(refs))
-        rows_remote = ray_tpu.remote(num_cpus=1)(_group_rows_task)
-        mg_remote = ray_tpu.remote(num_cpus=1)(_map_groups_task)
-        parts = [rows_remote.remote(i, self._key, R, *refs) for i in range(R)]
-        outs = [mg_remote.remote(p, fn) for p in parts]
+        part_remote = ray_tpu.remote(num_cpus=1)(_group_rows_partition_task).options(
+            num_returns=R
+        )
+        mg_remote = ray_tpu.remote(num_cpus=1)(_map_groups_reduce_task)
+        cols = [part_remote.remote(r, self._key, R) for r in refs]
+        outs = [
+            mg_remote.remote(
+                self._key, fn, *[(c[i] if R > 1 else c) for c in cols]
+            )
+            for i in range(R)
+        ]
         ds = Dataset(outs)
         ds._materialized = list(outs)
         return ds
@@ -281,7 +293,8 @@ def _sample_keys_task(block: Block, key: str, k: int) -> List[Any]:
 
 
 def _sort_partition_task(block: Block, key: str, bounds: List[Any], descending: bool):
-    """Range-partition one block by the sampled boundaries."""
+    """Range-partition one block by the sampled boundaries. One RETURN
+    VALUE per partition so each merge task fetches only its range."""
     block = normalize_block(block)
     keys = block[key]
     idx = np.searchsorted(np.asarray(bounds), keys, side="right")
@@ -290,12 +303,11 @@ def _sort_partition_task(block: Block, key: str, bounds: List[Any], descending: 
         parts.append(block_take(block, np.nonzero(idx == p)[0]))
     if descending:
         parts = parts[::-1]
-    return parts
+    return parts if len(parts) > 1 else parts[0]
 
 
-def _sort_merge_task(part_idx: int, key: str, descending: bool, *map_outputs):
-    blocks = [mo[part_idx] for mo in map_outputs]
-    blocks = [b for b in blocks if block_num_rows(b) > 0]
+def _sort_merge_task(key: str, descending: bool, *parts):
+    blocks = [b for b in parts if block_num_rows(b) > 0]
     if not blocks:
         return {}
     merged = block_concat(blocks)
@@ -329,12 +341,17 @@ def sort_dataset(ds, key: str, descending: bool = False):
         for i in range(R - 1)
         if int(len(samples) * (i + 1) / R) < len(samples)
     ]
-    part_remote = ray_tpu.remote(num_cpus=1)(_sort_partition_task)
+    P = len(bounds) + 1
+    part_remote = ray_tpu.remote(num_cpus=1)(_sort_partition_task).options(
+        num_returns=P
+    )
     merge_remote = ray_tpu.remote(num_cpus=1)(_sort_merge_task)
-    map_out = [part_remote.remote(r, key, bounds, descending) for r in refs]
+    cols = [part_remote.remote(r, key, bounds, descending) for r in refs]
     merged = [
-        merge_remote.remote(i, key, descending, *map_out)
-        for i in range(len(bounds) + 1)
+        merge_remote.remote(
+            key, descending, *[(c[i] if P > 1 else c) for c in cols]
+        )
+        for i in range(P)
     ]
     out = Dataset(merged)
     out._materialized = list(merged)

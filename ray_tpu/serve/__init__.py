@@ -27,6 +27,7 @@ import functools
 from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.controller import (
     CONTROLLER_NAME,
@@ -74,6 +75,7 @@ def deployment(
     ray_actor_options: Optional[Dict[str, Any]] = None,
     autoscaling_config: Optional[AutoscalingConfig] = None,
     route_prefix: Optional[str] = None,
+    version: Optional[str] = None,
 ):
     """Class/function decorator → Deployment (reference ``@serve.deployment``)."""
 
@@ -84,6 +86,7 @@ def deployment(
             ray_actor_options=dict(ray_actor_options or {}),
             autoscaling=autoscaling_config,
             route_prefix=route_prefix,
+            version=version,
         )
         return Deployment(cls_or_fn, name or cls_or_fn.__name__, cfg)
 
@@ -162,6 +165,7 @@ def shutdown() -> None:
 __all__ = [
     "Application",
     "AutoscalingConfig",
+    "batch",
     "Deployment",
     "DeploymentConfig",
     "DeploymentHandle",

@@ -30,3 +30,7 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     autoscaling: Optional[AutoscalingConfig] = None
     route_prefix: Optional[str] = None
+    #: rolling updates key on this (reference deployment_state.py:2331):
+    #: redeploying the SAME version is an in-place config update;
+    #: a different (or absent) version rolls replicas start-before-kill
+    version: Optional[str] = None

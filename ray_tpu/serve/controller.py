@@ -1,18 +1,22 @@
 """ServeController: reconciles deployments to their target state.
 
 Reference: ``serve/_private/controller.py:84`` (deploy_application
-``:719``), ``deployment_state.py:2331`` (replica FSM reconcile) and
-``autoscaling_state.py:262`` (queue-length autoscaling). One named
-controller actor owns the replica sets; handles/proxies query it for
-routing tables and it runs a control loop: start missing replicas,
-reap dead ones, and scale on the replicas' reported ongoing-request
-counts."""
+``:719``), ``deployment_state.py:2331`` (replica FSM + ROLLING updates
+keyed on deployment version) and ``autoscaling_state.py:262``
+(queue-length autoscaling). One named controller actor owns the replica
+sets and runs a control loop: start missing replicas, promote them once
+READY, reap dead ones, roll old-version replicas out start-before-kill,
+and scale on the replicas' reported ongoing-request counts. Routing
+tables are PUSHED to routers via long-poll (``long_poll.py`` in the
+reference): ``poll_replicas`` parks until the replica set version
+changes."""
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, List, Optional
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
@@ -28,12 +32,22 @@ class _DeploymentState:
         self.init_args = init_args
         self.init_kwargs = init_kwargs
         self.config = config
+        # every deploy without an explicit version is a new code version
+        # (the reference hashes config+code; we can't diff code, so a
+        # fresh uuid forces the same rolling replacement)
+        self.version: str = config.version or uuid.uuid4().hex[:8]
         self.target = (
             config.autoscaling.min_replicas if config.autoscaling else config.num_replicas
         )
-        self.replicas: List[Any] = []
+        #: READY replicas: (version, handle) — the routing set
+        self.replicas: List[Tuple[str, Any]] = []
+        #: started but not yet proven ready: (version, handle, started_at)
+        self.starting: List[Tuple[str, Any, float]] = []
+        #: unrouted, waiting for in-flight requests to finish before the
+        #: kill (graceful drain — zero-downtime rolls/scale-downs)
+        self.draining: List[Tuple[str, Any, float]] = []
         self.last_scale_ts = 0.0
-        self.ongoing_history: List[float] = []
+        self.last_stuck_evict_ts = 0.0
 
 
 class _ServeController:
@@ -46,25 +60,34 @@ class _ServeController:
         # control loop both reconcile, and unsynchronized passes would
         # double-start replicas then drop one set from tracking (leak)
         self._reconcile_lock = threading.Lock()
+        # long-poll state: bumped whenever any routing set changes
+        self._versions: Dict[str, int] = {}
+        self._change = threading.Condition()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._control_loop, daemon=True, name="serve-control"
         )
         self._thread.start()
 
+    def _bump(self, name: str) -> None:
+        with self._change:
+            self._versions[name] = self._versions.get(name, 0) + 1
+            self._change.notify_all()
+
     # -- API -------------------------------------------------------------
     def deploy(self, name, cls_or_fn, init_args, init_kwargs, config: DeploymentConfig) -> bool:
         with self._lock:
             old = self._deployments.get(name)
             state = _DeploymentState(name, cls_or_fn, init_args, init_kwargs, config)
-            self._deployments[name] = state
             if old is not None:
-                # rolling-update-lite: drop old replicas; reconcile starts new
-                for r in old.replicas:
-                    try:
-                        ray_tpu.kill(r)
-                    except Exception:
-                        pass
+                if config.version is not None and config.version == old.version:
+                    # same code version: in-place config update (scale);
+                    # existing replicas keep serving untouched
+                    state.version = old.version
+                state.replicas = old.replicas
+                state.starting = old.starting
+                state.draining = old.draining
+            self._deployments[name] = state
         self._reconcile_once()
         return True
 
@@ -73,17 +96,38 @@ class _ServeController:
             state = self._deployments.pop(name, None)
         if state is None:
             return False
-        for r in state.replicas:
+        all_handles = (
+            state.replicas
+            + [(v, h) for v, h, _t in state.starting]
+            + [(v, h) for v, h, _t in state.draining]
+        )
+        for _v, r in all_handles:
             try:
                 ray_tpu.kill(r)
             except Exception:
                 pass
+        self._bump(name)
         return True
 
     def get_replicas(self, name: str) -> List[Any]:
         with self._lock:
             state = self._deployments.get(name)
-            return list(state.replicas) if state else []
+            return [r for _v, r in state.replicas] if state else []
+
+    @ray_tpu.method(concurrency_group="longpoll")
+    def poll_replicas(self, name: str, known_version: int, timeout_s: float = 30.0):
+        """Long-poll (reference ``LongPollClient``): returns
+        ``(version, replicas)`` as soon as the routing set differs from
+        ``known_version`` (or on timeout, with the current state)."""
+        deadline = time.monotonic() + timeout_s
+        with self._change:
+            while self._versions.get(name, 0) == known_version:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop.is_set():
+                    break
+                self._change.wait(min(remaining, 1.0))
+            version = self._versions.get(name, 0)
+        return version, self.get_replicas(name)
 
     def routes(self) -> Dict[str, str]:
         """route_prefix -> deployment name (proxy routing table)."""
@@ -100,6 +144,8 @@ class _ServeController:
                 name: {
                     "target": st.target,
                     "replicas": len(st.replicas),
+                    "starting": len(st.starting),
+                    "version": st.version,
                     "autoscaling": st.config.autoscaling is not None,
                 }
                 for name, st in self._deployments.items()
@@ -114,11 +160,18 @@ class _ServeController:
             deployments = list(self._deployments.values())
             self._deployments.clear()
         for st in deployments:
-            for r in st.replicas:
+            handles = (
+                st.replicas
+                + [(v, h) for v, h, _t in st.starting]
+                + [(v, h) for v, h, _t in st.draining]
+            )
+            for _v, r in handles:
                 try:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
+        with self._change:
+            self._change.notify_all()
         return True
 
     # -- control loop ----------------------------------------------------
@@ -132,57 +185,162 @@ class _ServeController:
 
                 logging.getLogger(__name__).exception("serve control loop error")
 
+    def _spawn_replica(self, st: _DeploymentState):
+        opts = dict(st.config.ray_actor_options)
+        opts.setdefault("max_concurrency", st.config.max_concurrent_queries)
+        return Replica.options(**opts).remote(
+            st.cls_or_fn, st.init_args, st.init_kwargs
+        )
+
+    def _alive(self, replica) -> Optional[bool]:
+        """True=alive, False=dead, None=slow (indeterminate)."""
+        try:
+            ray_tpu.get(replica.stats.remote(), timeout=5)
+            return True
+        except ray_tpu.GetTimeoutError:
+            return None  # slow ≠ dead
+        except Exception:
+            return False
+
     def _reconcile_once(self) -> None:
         with self._reconcile_lock:
             with self._lock:
                 states = list(self._deployments.values())
             for st in states:
-                # reap dead replicas. A stats TIMEOUT is overload, not
-                # death — keep the replica (dropping it would churn
-                # healthy-but-slow replicas); real death (actor error /
-                # connection loss) drops it, with a defensive kill so a
-                # half-dead replica can't leak its reservation.
-                alive = []
-                for r in st.replicas:
-                    try:
-                        ray_tpu.get(r.stats.remote(), timeout=5)
-                        alive.append(r)
-                    except ray_tpu.GetTimeoutError:
-                        alive.append(r)  # slow ≠ dead
-                    except Exception:
+                changed = False
+                # 1. promote starters that became ready; reap failed ones
+                still_starting: List[Tuple[str, Any, float]] = []
+                for v, r, t0 in st.starting:
+                    ok = self._alive(r)
+                    if ok is True:
+                        st.replicas.append((v, r))
+                        changed = True
+                    elif ok is False or time.monotonic() - t0 > 120:
                         try:
                             ray_tpu.kill(r)
                         except Exception:
                             pass
+                    else:
+                        still_starting.append((v, r, t0))
+                st.starting = still_starting
+                # 2. reap dead ready replicas (timeout = overload, keep)
+                alive: List[Tuple[str, Any]] = []
+                for v, r in st.replicas:
+                    ok = self._alive(r)
+                    if ok is False:
+                        changed = True
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:
+                            pass
+                    else:
+                        alive.append((v, r))
                 st.replicas = alive
-                started: List[Any] = []
-                while len(st.replicas) + len(started) < st.target:
-                    opts = dict(st.config.ray_actor_options)
-                    opts.setdefault(
-                        "max_concurrency", st.config.max_concurrent_queries
-                    )
-                    started.append(
-                        Replica.options(**opts).remote(
-                            st.cls_or_fn, st.init_args, st.init_kwargs
+                cur = st.version
+                ready_cur = [(v, r) for v, r in st.replicas if v == cur]
+                ready_old = [(v, r) for v, r in st.replicas if v != cur]
+                starting_cur = [s for s in st.starting if s[0] == cur]
+                # 3. start replicas: scale-up AND rolling replacement are
+                # the same move — keep (ready_cur + starting_cur) headed
+                # toward target, start-before-kill. While OLD replicas
+                # exist the surge is capped at 1: TPU replicas hold chips,
+                # and a full-surge roll could never schedule.
+                start_cap = 1 if ready_old else st.target
+                while (
+                    len(ready_cur) + len(starting_cur) < st.target
+                    and len(starting_cur) < start_cap
+                ):
+                    h = self._spawn_replica(st)
+                    entry = (cur, h, time.monotonic())
+                    st.starting.append(entry)
+                    starting_cur.append(entry)
+                # resource-stuck roll: if the new replica can't come up
+                # (cluster can't fit target+1 — e.g. all chips held by
+                # old replicas), free one old after a grace period; the
+                # availability dip is then unavoidable, not a deadlock
+                now = time.monotonic()
+                if (
+                    ready_old
+                    and starting_cur
+                    and now - min(t for _v, _h, t in starting_cur) > 30
+                    # one eviction per grace period — keyed on the LAST
+                    # eviction, not the starter's (unchanging) start time,
+                    # or every 0.25s pass would drain another old replica
+                    # and a slow-starting v2 would cause a full outage
+                    and now - st.last_stuck_evict_ts > 30
+                ):
+                    st.last_stuck_evict_ts = now
+                    victim = ready_old.pop(0)
+                    st.replicas.remove(victim)
+                    st.draining.append((victim[0], victim[1], now))
+                    changed = True
+                # 4. rolling: once a current-version replica is ready,
+                # retire old-version replicas one-for-one (total ready
+                # never dips below target while old ones remain). Retire
+                # = UNROUTE now, kill only after in-flight requests drain
+                # (zero-downtime: a hard kill would fail them).
+                while ready_old and len(st.replicas) > st.target:
+                    victim = ready_old.pop(0)
+                    st.replicas.remove(victim)
+                    st.draining.append((victim[0], victim[1], time.monotonic()))
+                    changed = True
+                # 5. scale down current-version surplus (same drain)
+                while not ready_old and len(st.replicas) > st.target:
+                    v, r = st.replicas.pop()
+                    st.draining.append((v, r, time.monotonic()))
+                    changed = True
+                # 6. reap drained replicas: kill once idle (or after the
+                # 30s drain grace for stuck requests)
+                still_draining: List[Tuple[str, Any, float]] = []
+                for v, r, t0 in st.draining:
+                    idle = False
+                    try:
+                        idle = (
+                            ray_tpu.get(r.stats.remote(), timeout=5)["ongoing"] == 0
                         )
-                    )
+                    except ray_tpu.GetTimeoutError:
+                        idle = False  # saturated ≠ idle: wait out the grace
+                    except Exception:
+                        idle = True  # dead/unreachable: nothing to drain
+                    # ≥0.5s in drain before an idle-kill: routers need a
+                    # long-poll push cycle to drop the replica from their
+                    # cached set, or a just-dispatched request dies
+                    if (idle and time.monotonic() - t0 > 0.5) or (
+                        time.monotonic() - t0 > 30
+                    ):
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:
+                            pass
+                    else:
+                        still_draining.append((v, r, t0))
+                st.draining = still_draining
                 with self._lock:
-                    if self._deployments.get(st.name) is st:
-                        st.replicas.extend(started)
-                        started = []
-                # state swapped mid-reconcile (redeploy/delete): kill the
-                # replicas we just started for the stale state
-                for r in started:
-                    try:
-                        ray_tpu.kill(r)
-                    except Exception:
-                        pass
-                while len(st.replicas) > st.target:
-                    victim = st.replicas.pop()
-                    try:
-                        ray_tpu.kill(victim)
-                    except Exception:
-                        pass
+                    if self._deployments.get(st.name) is not st:
+                        # state swapped mid-reconcile (redeploy/delete):
+                        # hand our replicas to the new state object so
+                        # the roll continues from them
+                        newer = self._deployments.get(st.name)
+                        if newer is not None:
+                            newer.replicas = st.replicas
+                            newer.starting = st.starting
+                            newer.draining = st.draining
+                        else:
+                            # deleted mid-pass: kill EVERYTHING this pass
+                            # touched, incl. starters spawned after the
+                            # delete snapshotted its handles
+                            handles = (
+                                st.replicas
+                                + [(v, h) for v, h, _t in st.starting]
+                                + [(v, h) for v, h, _t in st.draining]
+                            )
+                            for _v, r in handles:
+                                try:
+                                    ray_tpu.kill(r)
+                                except Exception:
+                                    pass
+                if changed:
+                    self._bump(st.name)
 
     def _autoscale_once(self) -> None:
         now = time.monotonic()
@@ -192,7 +350,7 @@ class _ServeController:
             cfg: AutoscalingConfig = st.config.autoscaling
             total = 0.0
             n = 0
-            for r in st.replicas:
+            for _v, r in st.replicas:
                 try:
                     total += ray_tpu.get(r.stats.remote(), timeout=5)["ongoing"]
                     n += 1
@@ -218,6 +376,13 @@ ServeController = ray_tpu.remote(_ServeController)
 def get_or_create_controller():
     # get_if_exists handles the named-actor creation race internally
     # (actor.py) and real creation failures surface as themselves.
+    # long-polls park a thread each for up to 30s; a dedicated
+    # concurrency group keeps any number of routers from starving
+    # deploy/status/get_replicas lanes
     return ServeController.options(
-        name=CONTROLLER_NAME, num_cpus=0, max_concurrency=16, get_if_exists=True
+        name=CONTROLLER_NAME,
+        num_cpus=0,
+        max_concurrency=16,
+        concurrency_groups={"longpoll": 32},
+        get_if_exists=True,
     ).remote()

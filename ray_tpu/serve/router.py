@@ -1,23 +1,25 @@
-"""Router: pow-2 replica choice.
+"""Router: pow-2 replica choice over a PUSH-updated replica set.
 
 Reference: ``serve/_private/replica_scheduler/pow_2_scheduler.py:52`` —
-sample two replicas, compare their queue lengths, send to the shorter.
-The replica list refreshes from the controller periodically (long-poll
-equivalent of the reference's LongPollClient config push).
+sample two replicas, compare queue lengths, send to the shorter — fed by
+``long_poll.py``: the replica list arrives via a controller long-poll
+(a background thread parks in ``poll_replicas`` and wakes the moment
+the routing set changes), not a periodic poll. Deploys/scale-ups/
+replica deaths propagate to routers in milliseconds.
 
 Routing is at-most-once: a dispatch racing a replica death surfaces
-ActorDiedError on the returned ref (callers retry); the next refresh
-drops the dead replica from the candidate set."""
+ActorDiedError on the returned ref (callers retry); the next push drops
+the dead replica from the candidate set."""
 
 from __future__ import annotations
 
 import random
+import threading
 import time
-from typing import Any, List, Optional
+from typing import Any, List
 
 import ray_tpu
 
-_REFRESH_S = 1.0
 _STATS_TTL_S = 0.25
 
 
@@ -26,38 +28,63 @@ class Router:
         self._controller = controller
         self._deployment = deployment
         self._replicas: List[Any] = []
-        self._last_refresh = 0.0
+        self._replicas_lock = threading.Lock()
+        self._have_replicas = threading.Event()
         # replica -> (fetched_at, ongoing + local optimistic bumps):
         # fresh stats RPCs per dispatch would double request latency and
         # add 2x load (the reference compares CACHED queue lengths)
         self._stats: dict = {}
+        self._poller_started = False
+        self._poller_lock = threading.Lock()
 
-    def _refresh(self, force: bool = False) -> None:
-        now = time.monotonic()
-        if not force and now - self._last_refresh < _REFRESH_S and self._replicas:
-            return
-        self._replicas = ray_tpu.get(
-            self._controller.get_replicas.remote(self._deployment), timeout=30
-        )
-        self._last_refresh = now
-        # prune stats for replicas that no longer exist (cache is keyed by
-        # actor id — handle objects change identity every refresh)
-        live = {r.actor_id for r in self._replicas}
-        self._stats = {k: v for k, v in self._stats.items() if k in live}
+    # -- push subscription ----------------------------------------------
+    def _ensure_poller(self) -> None:
+        with self._poller_lock:
+            if self._poller_started:
+                return
+            self._poller_started = True
+            threading.Thread(
+                target=self._poll_loop, daemon=True, name=f"serve-router-{self._deployment}"
+            ).start()
 
-    def choose_replica(self):
-        self._refresh()
-        deadline = time.monotonic() + 30
-        while not self._replicas:
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no replicas for deployment {self._deployment!r}"
+    def _poll_loop(self) -> None:
+        version = -1  # first poll returns immediately with current state
+        while True:
+            try:
+                version, replicas = ray_tpu.get(
+                    self._controller.poll_replicas.remote(
+                        self._deployment, version, 30.0
+                    ),
+                    timeout=45,
                 )
-            time.sleep(0.1)
-            self._refresh(force=True)
-        if len(self._replicas) == 1:
-            return self._replicas[0]
-        a, b = random.sample(self._replicas, 2)
+                self._apply(replicas)
+            except Exception:
+                # controller briefly unavailable: back off, keep serving
+                # from the cached set
+                time.sleep(0.5)
+
+    def _apply(self, replicas: List[Any]) -> None:
+        with self._replicas_lock:
+            self._replicas = replicas
+            live = {r.actor_id for r in replicas}
+            self._stats = {k: v for k, v in self._stats.items() if k in live}
+        if replicas:
+            self._have_replicas.set()
+        else:
+            self._have_replicas.clear()
+
+    # -- choice ----------------------------------------------------------
+    def choose_replica(self):
+        self._ensure_poller()
+        if not self._have_replicas.wait(timeout=30):
+            raise RuntimeError(f"no replicas for deployment {self._deployment!r}")
+        with self._replicas_lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            return self.choose_replica()  # raced a scale-to-zero push
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
         qa, qb = self._queue_len(a), self._queue_len(b)
         return a if qa <= qb else b
 
@@ -72,7 +99,6 @@ class Router:
                 ray_tpu.get(replica.stats.remote(), timeout=10)["ongoing"]
             )
         except Exception:
-            self._refresh(force=True)
             ongoing = 0.0
         self._stats[key] = (now, ongoing)
         return ongoing

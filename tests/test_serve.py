@@ -163,3 +163,121 @@ def test_http_proxy(cluster):
     except urllib.error.HTTPError as e:
         assert e.code == 404
     serve.delete("Square")
+
+
+def test_dynamic_batching(cluster):
+    """@serve.batch: N concurrent requests coalesce into one replica
+    call with a list argument (reference serve/batching.py)."""
+
+    @serve.deployment(max_concurrent_queries=16)
+    class Batcher:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        async def handle(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+        async def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batcher.bind(), name="batcher")
+    refs = [handle.remote(i) for i in range(8)]
+    assert sorted(ray_tpu.get(refs, timeout=60)) == [i * 10 for i in range(8)]
+    sizes = ray_tpu.get(handle.method("sizes")(), timeout=30)
+    # all 8 concurrent requests should land in few (ideally 1) batches
+    assert max(sizes) >= 4, sizes
+    assert sum(sizes) == 8, sizes
+    serve.delete("Batcher")
+
+
+def test_batching_error_propagates(cluster):
+    @serve.deployment
+    class Bad:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        async def handle(self, items):
+            raise RuntimeError("batch boom")
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+    handle = serve.run(Bad.bind(), name="bad")
+    with pytest.raises(Exception, match="batch boom"):
+        ray_tpu.get(handle.remote(1), timeout=60)
+    serve.delete("Bad")
+
+
+def test_rolling_update_zero_downtime(cluster):
+    """Redeploying a new version rolls replicas start-before-kill: a
+    request stream across the roll never fails, and answers flip to the
+    new version (reference deployment_state.py:2331)."""
+
+    @serve.deployment(num_replicas=2, version="v1")
+    class Versioned:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def __call__(self, _x):
+            return self.tag
+
+    handle = serve.run(Versioned.bind("v1"), name="versioned")
+    assert ray_tpu.get(handle.remote(0), timeout=60) == "v1"
+
+    import threading
+
+    results, errors = [], []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                results.append(ray_tpu.get(handle.remote(0), timeout=30))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            time.sleep(0.02)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        serve.run(
+            Versioned.options(version="v2").bind("v2"), name="versioned"
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if results and results[-1] == "v2":
+                break
+            time.sleep(0.2)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not errors, errors[:3]
+    assert results[-1] == "v2", results[-5:]
+    assert "v1" in results  # the stream spanned the roll
+    serve.delete("Versioned")
+
+
+def test_same_version_redeploy_keeps_replicas(cluster):
+    """Deploying the SAME version is an in-place config update — the
+    running replicas survive (no churn)."""
+
+    @serve.deployment(num_replicas=1, version="stable")
+    class Stable:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, _x):
+            return self.pid
+
+    handle = serve.run(Stable.bind(), name="stable")
+    pid1 = ray_tpu.get(handle.remote(0), timeout=60)
+    serve.run(Stable.bind(), name="stable")  # same version again
+    time.sleep(1.0)
+    pid2 = ray_tpu.get(handle.remote(0), timeout=60)
+    assert pid1 == pid2
+    serve.delete("Stable")
