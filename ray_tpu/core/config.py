@@ -48,6 +48,12 @@ class GlobalConfig:
     idle_worker_killing_time_s: float = 300.0
     num_initial_workers: int = 0
 
+    # --- streaming generators ---
+    #: producer pauses once (produced - consumed) reaches this many
+    #: items; consumer progress resumes it (reference ObjectRefStream
+    #: consumer-position protocol, ``task_manager.h:102``). 0 disables.
+    streaming_generator_backpressure_items: int = 64
+
     # --- fault tolerance ---
     task_max_retries: int = 3
     actor_max_restarts: int = 0

@@ -130,6 +130,10 @@ class CoreWorker(RuntimeBackend):
         # created for an in-flight item.
         self._streams: Dict[bytes, Any] = {}
         self._streams_lock = threading.Lock()
+        # borrowed refs observed ready via a status RPC: lets a
+        # wait(timeout=0) poll answer from cache instead of paying the
+        # borrowed-status grace window every call (bounded FIFO)
+        self._borrowed_ready: "OrderedDict[bytes, None]" = OrderedDict()
         # task-event buffer (``core_worker/task_event_buffer`` →
         # ``GcsTaskManager``): batched lifecycle events for `list tasks`.
         # Locked: emitters run on lane/user threads, the flusher swaps the
@@ -364,7 +368,9 @@ class CoreWorker(RuntimeBackend):
                 # the owner, so grant them a short window — otherwise a
                 # timeout=0 poll loop would NEVER see a ready borrowed ref.
                 borrowed = any(
-                    not self.refcounter.owns(r.id()) and not self.memory.contains(r.id())
+                    not self.refcounter.owns(r.id())
+                    and not self.memory.contains(r.id())
+                    and r.id().binary() not in self._borrowed_ready
                     for r in refs
                 )
                 expired = deadline is not None and time.monotonic() >= deadline
@@ -422,6 +428,8 @@ class CoreWorker(RuntimeBackend):
         oid = ref.id()
         if self.memory.contains(oid):
             return
+        if oid.binary() in self._borrowed_ready:
+            return  # previously observed ready: readiness is monotone
         if self.refcounter.owns(oid):
             loop = asyncio.get_event_loop()
             ev = asyncio.Event()
@@ -451,6 +459,9 @@ class CoreWorker(RuntimeBackend):
                 return  # owner gone → get() will raise; count as "ready"
             if status["status"] in ("inline", "locations", "error", "unknown"):
                 # unknown == freed at the owner: get() raises, count ready
+                self._borrowed_ready[oid.binary()] = None
+                while len(self._borrowed_ready) > 8192:
+                    self._borrowed_ready.popitem(last=False)
                 return
             if deadline is not None and time.monotonic() >= deadline:
                 # caller's deadline: report not-ready by never resolving
@@ -875,7 +886,37 @@ class CoreWorker(RuntimeBackend):
             # last consumer position reached: drop the stream record
             with self._streams_lock:
                 self._streams.pop(task_id, None)
+        else:
+            self._report_stream_consumed(task_id, stream, index)
         return out
+
+    def _report_stream_consumed(self, task_id: bytes, stream, index: int) -> None:
+        """Throttled consumer-position report to the producing worker —
+        what resumes a generator paused on backpressure."""
+        threshold = GLOBAL_CONFIG.streaming_generator_backpressure_items
+        if threshold <= 0:
+            return
+        step = max(1, threshold // 2)
+        last = getattr(stream, "_last_reported", 0)
+        if index - last < step:
+            return
+        stream._last_reported = index
+        target = self._inflight_workers.get(task_id)
+        if target is None:
+            return
+        host, port = target
+
+        async def _send():
+            try:
+                await self._client(host, port).call(
+                    "stream_consumed",
+                    {"task_id": task_id, "consumed": index},
+                    timeout=10,
+                )
+            except Exception:
+                pass  # producer done/dead: nothing to unblock
+
+        self.io.post(_send())
 
     def abandon_stream(self, task_id: bytes, consumed_pos: int) -> None:
         """Generator dropped before exhaustion: release holds on items the
@@ -888,12 +929,14 @@ class CoreWorker(RuntimeBackend):
                 return
             with stream._cond:
                 undelivered = list(stream._items.values())
+                # gate the cancel on PRODUCER COMPLETION, not item-1
+                # readiness: a finished stream (total set / errored) has
+                # nothing running to cancel, while an unfinished one must
+                # be cancelled even if its first item was consumed long ago
+                finished = stream._total is not None or stream._error is not None
         self.release_hold(undelivered)
-        # cooperative-cancel the still-running producer task
-        try:
-            self._cancel_owned(ObjectID.from_index(TaskID(task_id), 1), force=False)
-        except Exception:
-            pass
+        if not finished:
+            self._cancel_task_by_id(task_id, force=False)
 
     def _on_stream_item(self, msg: Dict[str, Any]) -> None:
         """Worker-pushed stream item: record the value + ref."""
@@ -1416,7 +1459,11 @@ class CoreWorker(RuntimeBackend):
         obj = self.refcounter.get(oid)
         if obj is not None and obj.ready():
             return  # already finished — nothing to cancel (reference no-op)
-        tid = oid.task_id().binary()
+        self._cancel_task_by_id(oid.task_id().binary(), force)
+
+    def _cancel_task_by_id(self, tid: bytes, force: bool) -> None:
+        """Mark a task cancelled and notify its executing worker (shared
+        by ref-cancel and stream-abandon paths)."""
         self._cancelled_tasks[tid] = None
         while len(self._cancelled_tasks) > 8192:
             self._cancelled_tasks.popitem(last=False)
@@ -1584,6 +1631,15 @@ class CoreWorker(RuntimeBackend):
         if obj.inline is not None:
             return {"status": "inline", "data": obj.inline}
         return {"status": "locations", "locations": list(obj.locations)}
+
+    async def w_stream_consumed(self, payload, conn):
+        """Owner's consumer-position report for a streaming generator
+        running on this worker (backpressure resume signal)."""
+        if self.executor is not None:
+            self.executor.update_stream_consumed(
+                payload["task_id"], payload["consumed"]
+            )
+        return True
 
     async def w_cancel_task(self, payload, conn):
         """Cancel an executing/queued task on this worker."""

@@ -11,12 +11,13 @@ Retries are disabled for streaming tasks in this build (re-executing a
 partially-consumed stream has replay semantics the reference spent a
 protocol on; a died worker surfaces as the stream erroring).
 
-Known limitation vs the reference: no producer-side backpressure — a
-fast generator can outrun a slow consumer and grow the owner's buffer
-to the unconsumed backlog (the reference pauses generators at a
-configurable in-flight count). Consumed entries are trimmed, and
-abandoning the generator cancels the producer, so the backlog is
-bounded by the consumer's lag, not the stream length."""
+Producer-side backpressure (the reference's consumer-position protocol):
+the generator pauses once ``produced - consumed`` reaches
+``streaming_generator_backpressure_items``; the owner's throttled
+consumed reports (``w_stream_consumed``) resume it — so a fast producer
+against a slow consumer keeps the owner-side buffer bounded by the
+threshold, not the stream length. Consumed entries are trimmed, and
+abandoning the generator cancels a still-running producer."""
 
 from __future__ import annotations
 

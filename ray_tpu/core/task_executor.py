@@ -49,6 +49,10 @@ class TaskExecutor:
         self._running_threads: Dict[bytes, int] = {}
         self._cancel_lock = threading.Lock()
         self._env_gen = 0  # runtime-env application generation
+        # streaming backpressure: owner-reported consumer positions
+        self._stream_consumed: Dict[bytes, int] = {}
+        self._stream_events: Dict[bytes, threading.Event] = {}
+        self._stream_lock = threading.Lock()
 
     def bind(self, core, api_worker) -> None:
         self.core = core
@@ -319,6 +323,17 @@ class TaskExecutor:
 
         return restore
 
+    def update_stream_consumed(self, task_id: bytes, consumed: int) -> None:
+        """Owner's consumer-position report: wakes a producer paused on
+        backpressure (reference stream consumer-position protocol)."""
+        with self._stream_lock:
+            ev = self._stream_events.get(task_id)
+            if ev is None:
+                return  # stream finished: a late report must not re-insert
+            if consumed > self._stream_consumed.get(task_id, 0):
+                self._stream_consumed[task_id] = consumed
+        ev.set()
+
     def cancel_task(self, task_id: bytes, force: bool) -> bool:
         """Cooperative (or forced) cancellation (``CoreWorker::CancelTask``).
 
@@ -435,6 +450,11 @@ class TaskExecutor:
                 RuntimeError("streaming task executed without a stream channel"),
             )
             return [streaming_error_result(err)]
+        tid = spec.task_id.binary()
+        threshold = GLOBAL_CONFIG.streaming_generator_backpressure_items
+        if threshold > 0:
+            with self._stream_lock:
+                self._stream_events[tid] = threading.Event()
         count = 0
         try:
             result = fn(*args, **kwargs)
@@ -445,6 +465,25 @@ class TaskExecutor:
                 )
             for value in result:
                 count += 1
+                # producer-side backpressure: pause while the consumer
+                # lags by more than the threshold; the owner's consumed
+                # reports (w_stream_consumed) resume us. Cancellation is
+                # still honored while paused.
+                if threshold > 0:
+                    while (
+                        count - self._stream_consumed.get(tid, 0) > threshold
+                    ):
+                        with self._cancel_lock:
+                            if tid in self._cancelled:
+                                self._cancelled.discard(tid)
+                                raise TaskCancelledError(spec.task_id.hex()[:16])
+                        ev = self._stream_events.get(tid)
+                        if ev is None:
+                            break
+                        ev.clear()
+                        if count - self._stream_consumed.get(tid, 0) <= threshold:
+                            break
+                        ev.wait(0.5)
                 oid = ObjectID.from_index(spec.task_id, count)
                 kind, payload = self._store_value(oid, value, spec.name)
                 if kind == "error":
@@ -461,6 +500,10 @@ class TaskExecutor:
         except Exception as e:  # noqa: BLE001
             err = e if isinstance(e, TaskError) else TaskError(spec.name, e)
             return [streaming_error_result(err)]
+        finally:
+            with self._stream_lock:
+                self._stream_consumed.pop(tid, None)
+                self._stream_events.pop(tid, None)
         return [(b"", "stream_end", count)]
 
     def _store_value(self, oid: ObjectID, value: Any, name: str = "") -> Tuple[str, Any]:

@@ -179,13 +179,19 @@ def _group_rows_partition_task(block: Block, key: str, num_parts: int):
     if len(keys) == 0:
         empty = [{} for _ in range(num_parts)]
         return empty if num_parts > 1 else empty[0]
-    hashes = np.asarray(
-        [
-            _det_hash(k.item() if hasattr(k, "item") else k) % num_parts
-            for k in keys
-        ]
-    )
-    parts = [block_take(b, np.nonzero(hashes == p)[0]) for p in range(num_parts)]
+    # one hash per GROUP, not per row: sort once, find group boundaries,
+    # assign each segment its partition (same technique as the reduce)
+    order = np.argsort(keys, kind="stable")
+    sb = block_take(b, order)
+    sk = sb[key]
+    bounds = np.flatnonzero(sk[1:] != sk[:-1]) + 1
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [len(sk)]])
+    part_of = np.empty(len(sk), dtype=np.int64)
+    for s, e in zip(starts, ends):
+        kv = sk[s]
+        part_of[s:e] = _det_hash(kv.item() if hasattr(kv, "item") else kv) % num_parts
+    parts = [block_take(sb, np.nonzero(part_of == p)[0]) for p in range(num_parts)]
     return parts if num_parts > 1 else parts[0]
 
 

@@ -82,8 +82,13 @@ class _ServeController:
             if old is not None:
                 if config.version is not None and config.version == old.version:
                     # same code version: in-place config update (scale);
-                    # existing replicas keep serving untouched
+                    # existing replicas keep serving untouched — and an
+                    # autoscaled target must survive the redeploy, or the
+                    # pass after a config tweak drains replicas under load
                     state.version = old.version
+                    if config.autoscaling and old.config.autoscaling:
+                        state.target = old.target
+                        state.last_scale_ts = old.last_scale_ts
                 state.replicas = old.replicas
                 state.starting = old.starting
                 state.draining = old.draining
@@ -192,6 +197,23 @@ class _ServeController:
             st.cls_or_fn, st.init_args, st.init_kwargs
         )
 
+    def _core_actor_state(self, handle) -> Optional[str]:
+        """The runtime's actor FSM state for a replica (PENDING means the
+        cluster can't place it — the real resource-stuck signal)."""
+        try:
+            from ray_tpu.core.api import _global_worker
+
+            be = _global_worker().backend
+            info = be.io.run(
+                be.controller.call(
+                    "get_actor_info", {"actor_id": handle.actor_id}
+                ),
+                timeout=5,
+            )
+            return info["state"] if info else None
+        except Exception:
+            return None
+
     def _alive(self, replica) -> Optional[bool]:
         """True=alive, False=dead, None=slow (indeterminate)."""
         try:
@@ -208,14 +230,16 @@ class _ServeController:
                 states = list(self._deployments.values())
             for st in states:
                 changed = False
-                # 1. promote starters that became ready; reap failed ones
+                # 1. promote starters that became ready; reap only DEAD
+                # ones — slow init (large model loads) is normal for TPU
+                # replicas and must never trigger a kill/respawn loop
                 still_starting: List[Tuple[str, Any, float]] = []
                 for v, r, t0 in st.starting:
                     ok = self._alive(r)
                     if ok is True:
                         st.replicas.append((v, r))
                         changed = True
-                    elif ok is False or time.monotonic() - t0 > 120:
+                    elif ok is False:
                         try:
                             ray_tpu.kill(r)
                         except Exception:
@@ -268,6 +292,10 @@ class _ServeController:
                     # or every 0.25s pass would drain another old replica
                     # and a slow-starting v2 would cause a full outage
                     and now - st.last_stuck_evict_ts > 30
+                    # evict only when the starter is genuinely UNPLACEABLE
+                    # (actor FSM still PENDING) — a placed-but-slow init
+                    # (big model load) must not cost an old replica
+                    and self._core_actor_state(starting_cur[0][1]) == "PENDING"
                 ):
                     st.last_stuck_evict_ts = now
                     victim = ready_old.pop(0)

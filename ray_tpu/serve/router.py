@@ -16,11 +16,39 @@ from __future__ import annotations
 import random
 import threading
 import time
+import weakref
 from typing import Any, List
 
 import ray_tpu
 
 _STATS_TTL_S = 0.25
+
+
+def _poll_loop(router_ref: "weakref.ref", controller, deployment: str) -> None:
+    """Long-poll thread body. Holds only a WEAK ref to its router: when
+    the handle (and router) are garbage-collected, the thread notices on
+    its next wakeup and exits — dropped handles must not park controller
+    long-poll slots forever."""
+    version = -1  # first poll returns immediately with current state
+    while True:
+        r = router_ref()
+        if r is None or r._closed:
+            return
+        del r
+        try:
+            version, replicas = ray_tpu.get(
+                controller.poll_replicas.remote(deployment, version, 30.0),
+                timeout=45,
+            )
+            r = router_ref()
+            if r is None or r._closed:
+                return
+            r._apply(replicas)
+            del r
+        except Exception:
+            # controller briefly unavailable: back off, keep serving
+            # from the cached set
+            time.sleep(0.5)
 
 
 class Router:
@@ -36,6 +64,13 @@ class Router:
         self._stats: dict = {}
         self._poller_started = False
         self._poller_lock = threading.Lock()
+        self._closed = False
+
+    def close(self) -> None:
+        self._closed = True
+
+    def __del__(self):
+        self._closed = True
 
     # -- push subscription ----------------------------------------------
     def _ensure_poller(self) -> None:
@@ -44,24 +79,11 @@ class Router:
                 return
             self._poller_started = True
             threading.Thread(
-                target=self._poll_loop, daemon=True, name=f"serve-router-{self._deployment}"
+                target=_poll_loop,
+                args=(weakref.ref(self), self._controller, self._deployment),
+                daemon=True,
+                name=f"serve-router-{self._deployment}",
             ).start()
-
-    def _poll_loop(self) -> None:
-        version = -1  # first poll returns immediately with current state
-        while True:
-            try:
-                version, replicas = ray_tpu.get(
-                    self._controller.poll_replicas.remote(
-                        self._deployment, version, 30.0
-                    ),
-                    timeout=45,
-                )
-                self._apply(replicas)
-            except Exception:
-                # controller briefly unavailable: back off, keep serving
-                # from the cached set
-                time.sleep(0.5)
 
     def _apply(self, replicas: List[Any]) -> None:
         with self._replicas_lock:

@@ -140,3 +140,43 @@ def test_streaming_actor_method_rejected(cluster):
     a = A.remote()
     with pytest.raises(ValueError, match="streaming"):
         a.gen.options(num_returns="streaming").remote()
+
+
+def test_producer_backpressure_bounds_owner_buffer(cluster):
+    """A fast generator against a slow consumer keeps the owner-side
+    buffer bounded by streaming_generator_backpressure_items (reference
+    consumer-position protocol, task_manager.h:102)."""
+    import time as _t
+
+    from ray_tpu.core.api import _global_worker
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    threshold = GLOBAL_CONFIG.streaming_generator_backpressure_items
+    assert threshold > 0
+
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i
+
+    n = 2000
+    g = gen.options(num_returns="streaming").remote(n)
+    core = _global_worker().backend
+    tid = g._task_id
+    max_buffered = 0
+    out = []
+    for i, ref in enumerate(g):
+        out.append(ray_tpu.get(ref, timeout=60))
+        if i % 50 == 0:
+            _t.sleep(0.02)  # slow consumer
+            stream = core._streams.get(tid)
+            if stream is not None:
+                with stream._cond:
+                    max_buffered = max(max_buffered, len(stream._items))
+    assert out == list(range(n))
+    # buffered backlog stays around the threshold (small slack for the
+    # throttled consumed reports in flight)
+    assert max_buffered <= threshold + threshold // 2 + 2, (
+        max_buffered,
+        threshold,
+    )
