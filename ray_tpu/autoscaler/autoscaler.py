@@ -1,0 +1,191 @@
+"""StandardAutoscaler: the demand → node-type reconciler.
+
+Reference: ``python/ray/autoscaler/_private/autoscaler.py`` (the update
+loop) + ``resource_demand_scheduler.py`` (first-fit bin-packing of
+pending resource shapes onto node types). Each pass:
+
+1. snapshot demand from the controller (parked lease shapes, PENDING
+   actors, PENDING placement-group bundles) + node utilization,
+2. subtract what the LIVE cluster's spare capacity can absorb,
+3. first-fit-decreasing pack the remainder onto node types (a TPU slice
+   type contributes hosts x resources per launch) and launch,
+4. terminate provider nodes idle past ``idle_timeout_s``.
+
+TPU-aware: slices launch/terminate atomically — utilization is judged
+per provider NODE (all hosts of a slice idle before any terminate).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.config import AutoscalerConfig, NodeTypeConfig
+from ray_tpu.autoscaler.provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+def _fits(shape: Dict[str, float], capacity: Dict[str, float]) -> bool:
+    return all(capacity.get(k, 0.0) >= v for k, v in shape.items() if v > 0)
+
+
+def _subtract(capacity: Dict[str, float], shape: Dict[str, float]) -> None:
+    for k, v in shape.items():
+        if v > 0:
+            capacity[k] = capacity.get(k, 0.0) - v
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider, config: AutoscalerConfig, *, backend=None):
+        self._provider = provider
+        self._config = config
+        self._backend = backend  # CoreWorker-ish (controller RPC access)
+        self._idle_since: Dict[str, float] = {}  # provider node id -> ts
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="autoscaler"
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._config.update_interval_s):
+            try:
+                self.update()
+            except Exception:  # noqa: BLE001 — keep reconciling
+                logger.exception("autoscaler update failed")
+
+    # -- one reconcile pass ---------------------------------------------
+    def _demand(self) -> Dict[str, Any]:
+        backend = self._backend
+        if backend is None:
+            from ray_tpu.core.api import _global_worker
+
+            backend = _global_worker().backend
+        return backend.io.run(
+            backend.controller.call("autoscaler_demand", timeout=10), timeout=15
+        )
+
+    def update(self) -> None:
+        snap = self._demand()
+        shapes: List[Dict[str, float]] = (
+            list(snap["pending_tasks"])
+            + list(snap["pending_actors"])
+            + list(snap["pending_bundles"])
+        )
+        provider_nodes = self._provider.non_terminated_nodes()
+        # SLICES are the unit: group host records by launch group
+        groups: Dict[str, List[Dict[str, Any]]] = {}
+        for r in provider_nodes:
+            groups.setdefault(r.get("group", r["id"]), []).append(r)
+
+        # 2. live spare capacity absorbs demand first (per-node fitting)
+        spare: List[Dict[str, float]] = [
+            dict(n["available"]) for n in snap["nodes"] if n["alive"]
+        ]
+        unmet: List[Dict[str, float]] = []
+        for shape in sorted(shapes, key=lambda s: -sum(s.values())):
+            placed = False
+            for cap in spare:
+                if _fits(shape, cap):
+                    _subtract(cap, shape)
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(shape)
+
+        # 3. pack unmet demand onto node types; launch. Counting is per
+        # SLICE (launch group), not per host — max_workers bounds slices.
+        launches: List[NodeTypeConfig] = []
+        counts: Dict[str, int] = {}
+        for grp in groups.values():
+            counts[grp[0]["node_type"]] = counts.get(grp[0]["node_type"], 0) + 1
+        virtual: List[Dict[str, float]] = []
+        for shape in unmet:
+            placed = False
+            for cap in virtual:
+                if _fits(shape, cap):
+                    _subtract(cap, shape)
+                    placed = True
+                    break
+            if placed:
+                continue
+            nt = self._pick_type(shape, counts, len(groups) + len(launches))
+            if nt is None:
+                logger.warning("demand %s unschedulable on any node type", shape)
+                continue
+            counts[nt.name] = counts.get(nt.name, 0) + 1
+            launches.append(nt)
+            for _h in range(max(1, nt.hosts)):
+                cap = dict(nt.resources)
+                virtual.append(cap)
+            # place this shape on the fresh capacity
+            for cap in virtual:
+                if _fits(shape, cap):
+                    _subtract(cap, shape)
+                    break
+        for nt in launches:
+            logger.info("scaling up: launching %s (%d host(s))", nt.name, nt.hosts)
+            self._provider.create_node(nt)
+
+        # 4. terminate idle slices (never below min_workers). A slice is
+        # idle only when EVERY host is idle — half-terminating a TPU
+        # slice would leave a meaningless remnant.
+        now = time.monotonic()
+        node_rows = {n["node_id"]: n for n in snap["nodes"]}
+        min_by_type = {t.name: t.min_workers for t in self._config.node_types}
+        for gid, members in groups.items():
+            busy = bool(shapes)
+            for rec in members:
+                row = node_rows.get(rec.get("node_id_hex"))
+                if row is None or not row["alive"]:
+                    busy = True  # still joining (or lost): don't judge idle
+                    break
+                if any(
+                    row["available"].get(k, 0.0) < v
+                    for k, v in row["total"].items()
+                ):
+                    busy = True
+                    break
+            if busy:
+                self._idle_since.pop(gid, None)
+                continue
+            first_idle = self._idle_since.setdefault(gid, now)
+            if now - first_idle < self._config.idle_timeout_s:
+                continue
+            ntype = members[0]["node_type"]
+            if counts.get(ntype, 0) <= min_by_type.get(ntype, 0):
+                continue
+            logger.info("scaling down: terminating idle slice %s", gid)
+            counts[ntype] = counts.get(ntype, 1) - 1
+            self._idle_since.pop(gid, None)
+            for rec in members:
+                self._provider.terminate_node(rec["id"])
+
+    def _pick_type(
+        self, shape: Dict[str, float], counts: Dict[str, int], total_slices: int
+    ) -> Optional[NodeTypeConfig]:
+        if total_slices >= self._config.max_workers:
+            return None
+        best: Optional[NodeTypeConfig] = None
+        for nt in self._config.node_types:
+            if counts.get(nt.name, 0) >= nt.max_workers:
+                continue
+            if not _fits(shape, nt.resources):
+                continue
+            # smallest type that fits (first-fit-decreasing flavor)
+            if best is None or sum(nt.resources.values()) < sum(best.resources.values()):
+                best = nt
+        return best

@@ -43,6 +43,17 @@ class GlobalConfig:
     scheduler_spread_threshold: float = 0.5
     scheduler_top_k_fraction: float = 0.2
     worker_lease_timeout_s: float = 30.0
+    # An infeasible-NOW lease parks this long daemon-side before the
+    # infeasible verdict is returned: parked demand is what the
+    # autoscaler sees, and a joining node can make the shape feasible
+    # (reference: infeasible tasks wait forever and feed the load
+    # report).
+    infeasible_lease_grace_s: float = 10.0
+    # The CLIENT keeps retrying an infeasible verdict this long before
+    # failing the task — covers node boot time on autoscaled clusters
+    # (raise it when provisioning takes minutes) while keeping a crisp
+    # terminal error for static ones.
+    infeasible_fail_after_s: float = 30.0
     # Max workers the pool will cold-start concurrently (startup tokens).
     worker_maximum_startup_concurrency: int = 4
     idle_worker_killing_time_s: float = 300.0

@@ -402,6 +402,7 @@ class Controller:
             return {"unknown_node": True, "view": []}
         node.available = payload["available"]
         node.total = payload.get("total", node.total)
+        node.pending_leases = payload.get("pending_leases", [])
         node.last_sync = time.monotonic()
         node.health_failures = 0
         # adopt running actors a restored controller only knows as
@@ -456,6 +457,39 @@ class Controller:
             }
             for n in self.nodes.values()
         ]
+
+    async def c_autoscaler_demand(self, payload, conn):
+        """Demand snapshot for the autoscaler (reference
+        ``gcs_autoscaler_state_manager.h`` load report): resource shapes
+        the cluster cannot currently place, plus per-node utilization."""
+        pending_tasks: List[Dict[str, float]] = []
+        for n in self.nodes.values():
+            if n.alive:
+                pending_tasks.extend(getattr(n, "pending_leases", []))
+        pending_actors = [
+            dict(info.spec.resources)
+            for info in self.actors.values()
+            if info.state == "PENDING"
+        ]
+        pending_bundles: List[Dict[str, float]] = []
+        for pg in self.pgs.values():
+            if pg.state == "PENDING":
+                pending_bundles.extend(dict(b) for b in pg.bundles)
+        return {
+            "pending_tasks": pending_tasks,
+            "pending_actors": pending_actors,
+            "pending_bundles": pending_bundles,
+            "nodes": [
+                {
+                    "node_id": n.node_id.hex(),
+                    "alive": n.alive,
+                    "total": n.total,
+                    "available": n.available,
+                    "labels": n.labels,
+                }
+                for n in self.nodes.values()
+            ],
+        }
 
     async def c_cluster_resources(self, payload, conn):
         out: Dict[str, float] = {}

@@ -1025,6 +1025,7 @@ class CoreWorker(RuntimeBackend):
                 daemon_addr = target
                 daemon = self._client(*target)
         deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_s * 10
+        infeasible_since: Optional[float] = None
         while True:
             try:
                 reply = await daemon.call(
@@ -1050,9 +1051,19 @@ class CoreWorker(RuntimeBackend):
                 daemon_addr = (host, port)
                 continue
             if reply.get("infeasible"):
-                raise RayTpuError(
-                    f"task {spec.name} requires {spec.resources} which no node can ever satisfy"
-                )
+                # infeasible is terminal only after the patience window:
+                # on an autoscaled cluster the demand this request parks
+                # is what LAUNCHES the node that makes it feasible
+                now = time.monotonic()
+                if infeasible_since is None:
+                    infeasible_since = now
+                if now - infeasible_since >= GLOBAL_CONFIG.infeasible_fail_after_s:
+                    raise RayTpuError(
+                        f"task {spec.name} requires {spec.resources} which no node can satisfy"
+                    )
+                await asyncio.sleep(0.5)
+                continue
+            infeasible_since = None
             await asyncio.sleep(reply.get("retry_after", 0.05))
             if isinstance(spec.scheduling_strategy, PlacementGroupScheduling):
                 target = await self._pg_lease_target(spec.scheduling_strategy)

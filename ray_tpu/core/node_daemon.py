@@ -137,6 +137,9 @@ class NodeDaemon:
         self._peer_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._tasks: List[asyncio.Task] = []
         self._capacity_event = asyncio.Event()
+        # lease requests currently parked on capacity (autoscaler demand)
+        self._waiting_leases: Dict[int, Dict[str, float]] = {}
+        self._waiting_seq = 0
         self._last_oom_check = 0.0
         self._stopping = False
         for name in [m for m in dir(self) if m.startswith("d_")]:
@@ -359,6 +362,9 @@ class NodeDaemon:
                         "node_id": self.node_id.binary(),
                         "available": self.resources.available.to_dict(),
                         "total": self.resources.total.to_dict(),
+                        # parked lease shapes: task demand for the
+                        # autoscaler's bin-packing
+                        "pending_leases": list(self._waiting_leases.values()),
                         # running actors: a restarted controller adopts
                         # these instead of re-scheduling them (GCS-restart
                         # reconciliation, reference raylet reconnect)
@@ -617,19 +623,40 @@ class NodeDaemon:
         request: Dict[str, float] = payload["resources"]
         strategy = payload.get("strategy")
         deadline = time.monotonic() + 30.0
-        while True:
-            reply = await self._try_lease(request, strategy)
-            if reply is not None:
-                return reply
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                return {"retry_after": 0.05}
-            try:
-                await asyncio.wait_for(
-                    self._capacity_event.wait(), timeout=min(0.5, remaining)
-                )
-            except (asyncio.TimeoutError, TimeoutError):
-                pass
+        # visible to the resource sync → the AUTOSCALER's task-demand
+        # signal (reference: resource_demand_scheduler reads queued
+        # lease shapes from the load report)
+        self._waiting_seq += 1
+        wid = self._waiting_seq
+        first = True
+        grace_deadline = (
+            time.monotonic() + GLOBAL_CONFIG.infeasible_lease_grace_s
+        )
+        try:
+            while True:
+                reply = await self._try_lease(request, strategy)
+                if reply is not None:
+                    if reply.get("infeasible") and time.monotonic() < grace_deadline:
+                        # infeasible NOW ≠ infeasible forever: park so the
+                        # autoscaler sees the demand; a joining node flips
+                        # this to a grant/spillback
+                        reply = None
+                    else:
+                        return reply
+                if first:
+                    first = False
+                    self._waiting_leases[wid] = dict(request)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"retry_after": 0.05}
+                try:
+                    await asyncio.wait_for(
+                        self._capacity_event.wait(), timeout=min(0.5, remaining)
+                    )
+                except (asyncio.TimeoutError, TimeoutError):
+                    pass
+        finally:
+            self._waiting_leases.pop(wid, None)
 
     def _notify_capacity(self) -> None:
         """Wake queued lease requests (set() resolves current waiters even

@@ -21,6 +21,20 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 
+def _sorted_group_segments(block: Block, key: str):
+    """Stable-sort a block by ``key`` and return
+    ``(sorted_block, sorted_keys, starts, ends)`` where each
+    ``[starts[i], ends[i])`` is one group's contiguous segment — the one
+    grouping idiom shared by map, partition, and reduce tasks."""
+    order = np.argsort(block[key], kind="stable")
+    sb = block_take(block, order)
+    sk = sb[key]
+    bounds = np.flatnonzero(sk[1:] != sk[:-1]) + 1
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [len(sk)]])
+    return sb, sk, starts, ends
+
+
 def _det_hash(value: Any) -> int:
     """Deterministic cross-process key hash: Python's ``hash()`` is
     salted per process (PYTHONHASHSEED), which would route the same key
@@ -130,13 +144,7 @@ def _group_map_task(block: Block, key: str, aggs: List[AggregateFn], num_parts: 
     keys = block[key]
     if len(keys) == 0:
         return parts
-    order = np.argsort(keys, kind="stable")
-    sorted_block = block_take(block, order)
-    skeys = sorted_block[key]
-    # group boundaries in the sorted block
-    bounds = np.flatnonzero(skeys[1:] != skeys[:-1]) + 1
-    starts = np.concatenate([[0], bounds])
-    ends = np.concatenate([bounds, [len(skeys)]])
+    sorted_block, skeys, starts, ends = _sorted_group_segments(block, key)
     for s, e in zip(starts, ends):
         kv = skeys[s]
         sub = {c: v[s:e] for c, v in sorted_block.items()}
@@ -181,12 +189,7 @@ def _group_rows_partition_task(block: Block, key: str, num_parts: int):
         return empty if num_parts > 1 else empty[0]
     # one hash per GROUP, not per row: sort once, find group boundaries,
     # assign each segment its partition (same technique as the reduce)
-    order = np.argsort(keys, kind="stable")
-    sb = block_take(b, order)
-    sk = sb[key]
-    bounds = np.flatnonzero(sk[1:] != sk[:-1]) + 1
-    starts = np.concatenate([[0], bounds])
-    ends = np.concatenate([bounds, [len(sk)]])
+    sb, sk, starts, ends = _sorted_group_segments(b, key)
     part_of = np.empty(len(sk), dtype=np.int64)
     for s, e in zip(starts, ends):
         kv = sk[s]
@@ -201,12 +204,7 @@ def _map_groups_reduce_task(key: str, fn, *part_blocks):
     merged = block_concat([normalize_block(p) for p in part_blocks if p])
     if not merged or len(merged.get(key, ())) == 0:
         return {}
-    order = np.argsort(merged[key], kind="stable")
-    sb = block_take(merged, order)
-    sk = sb[key]
-    bounds = np.flatnonzero(sk[1:] != sk[:-1]) + 1
-    starts = np.concatenate([[0], bounds])
-    ends = np.concatenate([bounds, [len(sk)]])
+    sb, _sk, starts, ends = _sorted_group_segments(merged, key)
     outs = []
     for s, e in zip(starts, ends):
         outs.append(normalize_block(fn({c: v[s:e] for c, v in sb.items()})))

@@ -48,6 +48,8 @@ class _DeploymentState:
         self.draining: List[Tuple[str, Any, float]] = []
         self.last_scale_ts = 0.0
         self.last_stuck_evict_ts = 0.0
+        #: last time a starter died as runtime-unplaceable
+        self.unplaceable_ts = 0.0
 
 
 class _ServeController:
@@ -197,20 +199,20 @@ class _ServeController:
             st.cls_or_fn, st.init_args, st.init_kwargs
         )
 
-    def _core_actor_state(self, handle) -> Optional[str]:
-        """The runtime's actor FSM state for a replica (PENDING means the
-        cluster can't place it — the real resource-stuck signal)."""
+    def _core_actor_info(self, handle) -> Optional[Dict[str, Any]]:
+        """The runtime's actor FSM view for a replica (PENDING or a
+        death reason of "no node can host" both mean the cluster can't
+        place it — the real resource-stuck signals)."""
         try:
             from ray_tpu.core.api import _global_worker
 
             be = _global_worker().backend
-            info = be.io.run(
+            return be.io.run(
                 be.controller.call(
                     "get_actor_info", {"actor_id": handle.actor_id}
                 ),
                 timeout=5,
             )
-            return info["state"] if info else None
         except Exception:
             return None
 
@@ -240,6 +242,15 @@ class _ServeController:
                         st.replicas.append((v, r))
                         changed = True
                     elif ok is False:
+                        # a starter the RUNTIME failed as unplaceable is
+                        # the resource-stuck signal (the core fails such
+                        # actors at its lease timeout, typically before
+                        # our PENDING-age gate can observe them)
+                        info = self._core_actor_info(r)
+                        if info and str(info.get("reason", "")).startswith(
+                            "no node can host"
+                        ):
+                            st.unplaceable_ts = time.monotonic()
                         try:
                             ray_tpu.kill(r)
                         except Exception:
@@ -283,19 +294,27 @@ class _ServeController:
                 # old replicas), free one old after a grace period; the
                 # availability dip is then unavoidable, not a deadlock
                 now = time.monotonic()
+                # resource-stuck: either a live starter is still PENDING
+                # past the grace (cluster can't fit target+1), or the
+                # runtime already failed a starter as unplaceable. A
+                # placed-but-slow init (big model load) matches neither.
+                starter_pending = bool(starting_cur) and (
+                    now - min(t for _v, _h, t in starting_cur) > 30
+                    and (self._core_actor_info(starting_cur[0][1]) or {}).get(
+                        "state"
+                    )
+                    == "PENDING"
+                )
+                recently_unplaceable = now - st.unplaceable_ts < 60 and (
+                    st.unplaceable_ts > 0
+                )
                 if (
                     ready_old
-                    and starting_cur
-                    and now - min(t for _v, _h, t in starting_cur) > 30
-                    # one eviction per grace period — keyed on the LAST
-                    # eviction, not the starter's (unchanging) start time,
-                    # or every 0.25s pass would drain another old replica
-                    # and a slow-starting v2 would cause a full outage
+                    and (starter_pending or recently_unplaceable)
+                    # one eviction per grace period — or every 0.25s pass
+                    # would drain another old replica and a slow roll
+                    # would cause a full outage
                     and now - st.last_stuck_evict_ts > 30
-                    # evict only when the starter is genuinely UNPLACEABLE
-                    # (actor FSM still PENDING) — a placed-but-slow init
-                    # (big model load) must not cost an old replica
-                    and self._core_actor_state(starting_cur[0][1]) == "PENDING"
                 ):
                     st.last_stuck_evict_ts = now
                     victim = ready_old.pop(0)

@@ -1,0 +1,138 @@
+"""Autoscaler: demand-driven scale-up on the fake provider, idle
+scale-down, and atomic TPU-slice launches.
+
+Reference test model: ``python/ray/tests/test_autoscaler_fake_multinode.py``
+on ``FakeMultiNodeProvider`` (``fake_multi_node/node_provider.py:236``).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    FakeMultiNodeProvider,
+    NodeTypeConfig,
+    StandardAutoscaler,
+)
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture()
+def small_cluster():
+    cluster = Cluster(num_cpus=1)
+    ray_tpu.init(address=cluster.address)
+    provider = FakeMultiNodeProvider(f"127.0.0.1:{cluster.controller_port}")
+    yield cluster, provider
+    try:
+        provider.shutdown()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def _wait(pred, timeout=60, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.25)
+    raise AssertionError(f"timed out: {msg}")
+
+
+def test_scale_up_schedule_and_idle_terminate(small_cluster):
+    """Infeasible-now work launches a fake node, the work schedules on
+    it, and the node terminates once idle past the timeout."""
+    _cluster, provider = small_cluster
+    autoscaler = StandardAutoscaler(
+        provider,
+        AutoscalerConfig(
+            node_types=[NodeTypeConfig("worker", {"CPU": 4}, max_workers=2)],
+            idle_timeout_s=2.0,
+            update_interval_s=0.3,
+        ),
+    )
+    autoscaler.start()
+    try:
+
+        @ray_tpu.remote(num_cpus=4)
+        class Big:
+            def ping(self):
+                return "pong"
+
+        # head has 1 CPU: this actor is unschedulable until a node appears
+        a = Big.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=90) == "pong"
+        assert len(provider.non_terminated_nodes()) >= 1
+
+        # drop the actor: its node should go idle and be terminated
+        del a
+        _wait(
+            lambda: len(provider.non_terminated_nodes()) == 0,
+            timeout=60,
+            msg="idle node should terminate",
+        )
+    finally:
+        autoscaler.stop()
+
+
+def test_task_demand_scales_up(small_cluster):
+    """Parked lease requests (queued tasks) also count as demand."""
+    _cluster, provider = small_cluster
+    autoscaler = StandardAutoscaler(
+        provider,
+        AutoscalerConfig(
+            node_types=[NodeTypeConfig("worker", {"CPU": 4}, max_workers=1)],
+            idle_timeout_s=30.0,
+            update_interval_s=0.3,
+        ),
+    )
+    autoscaler.start()
+    try:
+
+        @ray_tpu.remote(num_cpus=3)
+        def heavy():
+            return 42
+
+        assert ray_tpu.get(heavy.remote(), timeout=90) == 42
+        assert len(provider.non_terminated_nodes()) == 1
+    finally:
+        autoscaler.stop()
+
+
+def test_tpu_slice_launches_atomically(small_cluster):
+    """A slice node type (hosts=2) launches both hosts in one scaling
+    decision — TPU slices are indivisible units."""
+    _cluster, provider = small_cluster
+    autoscaler = StandardAutoscaler(
+        provider,
+        AutoscalerConfig(
+            node_types=[
+                NodeTypeConfig(
+                    "v5e-slice", {"CPU": 1, "FAKETPU": 4}, max_workers=1, hosts=2
+                )
+            ],
+            idle_timeout_s=60.0,
+            update_interval_s=0.3,
+        ),
+    )
+    autoscaler.start()
+    try:
+
+        @ray_tpu.remote(num_cpus=0, resources={"FAKETPU": 4})
+        def on_slice():
+            return "ok"
+
+        assert ray_tpu.get(on_slice.remote(), timeout=90) == "ok"
+        nodes = provider.non_terminated_nodes()
+        assert len(nodes) == 2, nodes  # both slice hosts
+        _wait(
+            lambda: sum(
+                1 for n in ray_tpu.nodes() if n["Alive"]
+            ) >= 3,
+            timeout=30,
+            msg="both slice hosts join the cluster",
+        )
+    finally:
+        autoscaler.stop()
