@@ -3,7 +3,11 @@
 Reference: ``python/ray/tune/`` (Tuner/TuneController, basic-variant
 search, ASHA). See ``tuner.py`` for the controller design."""
 
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
 from ray_tpu.tune.search import (
     choice,
     grid_search,
@@ -12,12 +16,14 @@ from ray_tpu.tune.search import (
     randint,
     uniform,
 )
-from ray_tpu.tune.trial import Trial, get_config, report
+from ray_tpu.tune.trial import Trial, get_checkpoint, get_config, report
 from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner
 
 __all__ = [
     "ASHAScheduler",
     "FIFOScheduler",
+    "PopulationBasedTraining",
+    "get_checkpoint",
     "ResultGrid",
     "Trial",
     "TrialResult",

@@ -32,6 +32,9 @@ class Trial:
     iterations: int = 0
     error: Optional[str] = None
     actor: Any = None
+    #: latest checkpoint payload reported by the trainable (PBT exploit
+    #: source + experiment-resume restore point)
+    last_checkpoint: Any = None
 
 
 # ---- in-trial session (set inside the trial actor process) -------------
@@ -40,32 +43,49 @@ _session: Optional["_TrialSession"] = None
 
 
 class _TrialSession:
-    def __init__(self, config: Dict[str, Any], trial_id: str = ""):
+    def __init__(self, config: Dict[str, Any], trial_id: str = "", checkpoint: Any = None):
         self.config = config
         self.trial_id = trial_id
+        self.start_checkpoint = checkpoint
         self._reports: List[Dict[str, Any]] = []
+        self._checkpoints: List[Any] = []  # aligned with reports (or None)
         self._lock = threading.Lock()
 
-    def report(self, metrics: Dict[str, Any]) -> None:
+    def report(self, metrics: Dict[str, Any], checkpoint: Any = None) -> None:
         with self._lock:
             self._reports.append(dict(metrics))
+            self._checkpoints.append(checkpoint)
 
-    def drain(self) -> List[Dict[str, Any]]:
+    def drain(self):
         with self._lock:
             out, self._reports = self._reports, []
-            return out
+            cks, self._checkpoints = self._checkpoints, []
+            return out, cks
 
 
-def report(metrics: Dict[str, Any], **kwargs) -> None:
+def report(metrics: Dict[str, Any] = None, *, checkpoint: Any = None, **kwargs) -> None:
     """Report trial metrics (``ray.tune.report``). Accepts a dict and/or
-    keyword metrics; one report = one iteration for the scheduler."""
+    keyword metrics; one report = one iteration for the scheduler.
+    ``checkpoint`` is any picklable payload — it becomes the trial's
+    restore point for PBT exploits and experiment resume."""
     merged = dict(metrics or {})
     merged.update(kwargs)
     with _session_lock:
         s = _session
     if s is None:
         raise RuntimeError("tune.report() called outside a trial")
-    s.report(merged)
+    s.report(merged, checkpoint)
+
+
+def get_checkpoint() -> Any:
+    """The checkpoint this trial was (re)started with — None on a fresh
+    start (reference ``tune.get_checkpoint``). A PBT exploit restarts the
+    trial with the exploited peer's checkpoint here."""
+    with _session_lock:
+        s = _session
+    if s is None:
+        raise RuntimeError("tune.get_checkpoint() called outside a trial")
+    return s.start_checkpoint
 
 
 def get_config() -> Dict[str, Any]:
@@ -100,9 +120,9 @@ class _TrialRunner:
         self._done = threading.Event()
         self._error: Optional[str] = None
 
-    def run(self, trainable, config: Dict[str, Any], trial_id: str = "") -> bool:
+    def run(self, trainable, config: Dict[str, Any], trial_id: str = "", checkpoint: Any = None) -> bool:
         global _session
-        self._session = _TrialSession(config, trial_id)
+        self._session = _TrialSession(config, trial_id, checkpoint)
         with _session_lock:
             _session = self._session
 
@@ -125,8 +145,15 @@ class _TrialRunner:
     def poll(self) -> Dict[str, Any]:
         done = self._done.is_set()  # snapshot BEFORE drain (see train)
         error = self._error
-        reports = self._session.drain() if self._session else []
-        return {"reports": reports, "done": done, "error": error}
+        reports, checkpoints = (
+            self._session.drain() if self._session else ([], [])
+        )
+        return {
+            "reports": reports,
+            "checkpoints": checkpoints,
+            "done": done,
+            "error": error,
+        }
 
 
 TrialRunner = ray_tpu.remote(_TrialRunner)

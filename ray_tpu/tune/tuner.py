@@ -13,13 +13,14 @@ actor is control-plane only, the gang runs under it."""
 
 from __future__ import annotations
 
+import os
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler
 from ray_tpu.tune.search import generate_variants
 from ray_tpu.tune.trial import (
     ERRORED,
@@ -111,11 +112,83 @@ class Tuner:
         param_space: Optional[Dict[str, Any]] = None,
         tune_config: Optional[TuneConfig] = None,
         resources_per_trial: Optional[Dict[str, float]] = None,
+        run_config: Any = None,  # train.RunConfig: name + storage_path
     ):
         self._trainable = self._as_function(trainable)
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.resources_per_trial = resources_per_trial or {"CPU": 1.0}
+        self.run_config = run_config
+        self._restored_trials: Optional[List[Trial]] = None
+
+    # -- experiment snapshot/resume (reference experiment_state.py) -----
+    def _snapshot_path(self) -> Optional[str]:
+        rc = self.run_config
+        if rc is None or not getattr(rc, "storage_path", None):
+            return None
+        name = getattr(rc, "name", None) or "tune_experiment"
+        return os.path.join(rc.storage_path, name, "tuner.pkl")
+
+    def _save_snapshot(self, trials: List[Trial]) -> None:
+        path = self._snapshot_path()
+        if path is None:
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        state = {
+            "param_space": self.param_space,
+            "tune_config": self.tune_config,
+            "resources_per_trial": self.resources_per_trial,
+            "run_config": self.run_config,
+            "trials": [
+                Trial(
+                    trial_id=t.trial_id,
+                    config=t.config,
+                    status=t.status,
+                    last_metrics=t.last_metrics,
+                    metrics_history=list(t.metrics_history),
+                    iterations=t.iterations,
+                    error=t.error,
+                    last_checkpoint=t.last_checkpoint,
+                )
+                for t in trials
+            ],
+        }
+        tmp = path + ".tmp"
+        try:
+            import cloudpickle  # schedulers may hold lambdas (PBT mutations)
+
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(state, f)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 — a snapshot must never kill a sweep
+            import logging
+
+            logging.getLogger(__name__).exception("experiment snapshot failed")
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    @classmethod
+    def restore(cls, path: str, trainable: Any) -> "Tuner":
+        """Resume a killed/crashed sweep from its snapshot (reference
+        ``Tuner.restore``): finished trials keep their results;
+        unfinished ones restart from their latest reported checkpoint."""
+        if os.path.isdir(path):
+            path = os.path.join(path, "tuner.pkl")
+        import cloudpickle
+
+        with open(path, "rb") as f:
+            state = cloudpickle.load(f)
+        tuner = cls(
+            trainable,
+            param_space=state["param_space"],
+            tune_config=state["tune_config"],
+            resources_per_trial=state["resources_per_trial"],
+            run_config=state["run_config"],
+        )
+        tuner._restored_trials = state["trials"]
+        return tuner
 
     @staticmethod
     def _as_function(trainable: Any) -> Callable[[Dict[str, Any]], Any]:
@@ -158,30 +231,55 @@ class Tuner:
         if getattr(scheduler, "mode", "x") is None:
             scheduler.mode = cfg.mode
         metric = getattr(scheduler, "metric", None) or cfg.metric
-        variants = generate_variants(
-            self.param_space, num_samples=cfg.num_samples, seed=cfg.seed
-        )
-        trials = [
-            Trial(trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:6]}", config=v)
-            for i, v in enumerate(variants)
-        ]
-        pending = list(trials)
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+            # unfinished trials restart (from their latest checkpoint)
+            pending = []
+            for t in trials:
+                if t.status in (PENDING, RUNNING):
+                    t.status = PENDING
+                    t.actor = None
+                    pending.append(t)
+        else:
+            variants = generate_variants(
+                self.param_space, num_samples=cfg.num_samples, seed=cfg.seed
+            )
+            trials = [
+                Trial(trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:6]}", config=v)
+                for i, v in enumerate(variants)
+            ]
+            pending = list(trials)
+        trials_by_id = {t.trial_id: t for t in trials}
         launching: List[tuple] = []  # (trial, run_ref): actor may be queued
         running: List[Trial] = []
         opts = dict(self.resources_per_trial)
         num_cpus = opts.pop("CPU", 1.0)
+        last_snapshot = 0.0
+
+        def launch(t: Trial) -> None:
+            t.actor = TrialRunner.options(
+                num_cpus=num_cpus, resources=opts or None
+            ).remote()
+            # Fire-and-track: the actor may wait arbitrarily long for
+            # cluster capacity — a blocking get() here would stall the
+            # poll loop (frozen ASHA decisions) and crash the sweep on
+            # an oversubscribed cluster.
+            launching.append(
+                (
+                    t,
+                    t.actor.run.remote(
+                        self._trainable, t.config, t.trial_id, t.last_checkpoint
+                    ),
+                )
+            )
 
         while pending or launching or running:
+            now = time.monotonic()
+            if now - last_snapshot > 2.0:
+                last_snapshot = now
+                self._save_snapshot(trials)
             while pending and len(launching) + len(running) < cfg.max_concurrent_trials:
-                t = pending.pop(0)
-                t.actor = TrialRunner.options(
-                    num_cpus=num_cpus, resources=opts or None
-                ).remote()
-                # Fire-and-track: the actor may wait arbitrarily long for
-                # cluster capacity — a blocking get() here would stall the
-                # poll loop (frozen ASHA decisions) and crash the sweep on
-                # an oversubscribed cluster.
-                launching.append((t, t.actor.run.remote(self._trainable, t.config, t.trial_id)))
+                launch(pending.pop(0))
 
             still_launching: List[tuple] = []
             for t, run_ref in launching:
@@ -215,18 +313,43 @@ class Tuner:
                     scheduler.on_trial_complete(t.trial_id)
                     continue
                 stop = False
-                for report in poll["reports"]:
+                exploit_src: Optional[str] = None
+                checkpoints = poll.get("checkpoints") or [None] * len(poll["reports"])
+                for report, ck in zip(poll["reports"], checkpoints):
+                    # every drained report is recorded and fed to the
+                    # scheduler even after a decision fires — a batch must
+                    # never silently truncate history/checkpoints
                     t.iterations += 1
                     t.last_metrics = report
                     t.metrics_history.append(report)
+                    if ck is not None:
+                        t.last_checkpoint = ck
                     value = report.get(metric) if metric else None
-                    if value is not None:
+                    if value is not None and not stop and exploit_src is None:
                         decision = scheduler.on_result(
                             t.trial_id, t.iterations, float(value)
                         )
                         if decision == STOP:
                             stop = True
-                            break
+                        elif (
+                            isinstance(decision, tuple)
+                            and decision[0] == EXPLOIT
+                        ):
+                            exploit_src = decision[1]
+                if exploit_src is not None and not stop:
+                    # PBT exploit/explore: restart from the top peer's
+                    # checkpoint with a mutated copy of its config
+                    src = trials_by_id.get(exploit_src)
+                    if src is not None and src.last_checkpoint is not None:
+                        ray_tpu.kill(t.actor)
+                        t.config = scheduler.explore(dict(src.config))
+                        t.last_checkpoint = src.last_checkpoint
+                        t.status = PENDING
+                        pending.append(t)
+                        continue
+                    # source has nothing to exploit yet: keep running
+                    still_running.append(t)
+                    continue
                 if stop:
                     t.status = STOPPED
                     scheduler.on_trial_complete(t.trial_id)
@@ -246,6 +369,7 @@ class Tuner:
             if pending or launching or running:
                 time.sleep(0.02)
 
+        self._save_snapshot(trials)
         return ResultGrid(
             [
                 TrialResult(

@@ -142,3 +142,133 @@ def test_trainer_as_trainable(cluster):
     best = grid.get_best_result()
     assert best.config["lr"] == 4.0
     assert best.metrics["loss"] == 0.25
+
+
+def test_pbt_exploits_and_mutates(cluster):
+    """PBT: a bottom-quantile trial restarts from a top peer's checkpoint
+    with a mutated config mid-training (reference schedulers/pbt.py)."""
+
+    def trainable(config):
+        # resume from an exploited checkpoint if one was handed to us
+        ck = tune.get_checkpoint()
+        step = ck["step"] if ck else 0
+        score = ck["score"] if ck else 0.0
+        while step < 16:
+            step += 1
+            score += config["lr"]  # higher lr -> faster score growth
+            tune.report(
+                {"score": score, "lr": config["lr"]},
+                checkpoint={"step": step, "score": score},
+            )
+            # slow enough that the controller interleaves the two trials'
+            # reports (PBT decisions need a live population)
+            time.sleep(0.1)
+
+    scheduler = tune.PopulationBasedTraining(
+        perturbation_interval=3,
+        quantile_fraction=0.5,
+        hyperparam_mutations={"lr": lambda: 1.0},
+        seed=7,
+    )
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.1, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=scheduler,
+            max_concurrent_trials=2,
+        ),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    # the weak trial (lr=0.1) must have been exploited at least once:
+    # its reported lr changes mid-history OR its score jumps to the
+    # strong trial's trajectory
+    exploited = False
+    for r in grid:
+        lrs = {m["lr"] for m in r.metrics_history if "lr" in m}
+        if len(lrs) > 1:
+            exploited = True
+    assert exploited, [
+        [m.get("lr") for m in r.metrics_history] for r in grid
+    ]
+
+
+def test_experiment_snapshot_and_resume(cluster, tmp_path):
+    """Kill-and-resume: a snapshot taken mid-sweep restores finished
+    results and restarts unfinished trials from their checkpoints
+    (reference execution/experiment_state.py)."""
+    from ray_tpu.train import RunConfig
+
+    marker = tmp_path / "slow_mode"
+    marker.write_text("on")
+
+    def trainable(config):
+        ck = tune.get_checkpoint()
+        start = ck["i"] if ck else 0
+        import os as _os
+
+        for i in range(start, 6):
+            tune.report(
+                {"i": i, "x": config["x"], "start": start},
+                checkpoint={"i": i + 1},
+            )
+            # first run is slow so the driver can "die" mid-sweep;
+            # the resumed run sees the marker gone and finishes fast
+            if _os.path.exists(str(config["marker"])):
+                time.sleep(0.3)
+
+    run_config = RunConfig(name="resume_exp", storage_path=str(tmp_path))
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3, 4]), "marker": str(marker)},
+        tune_config=tune.TuneConfig(metric="x", mode="max", max_concurrent_trials=2),
+        run_config=run_config,
+    )
+
+    # simulate a driver crash: run fit() in a thread and abandon it
+    import threading
+
+    done = threading.Event()
+
+    def doomed():
+        try:
+            tuner.fit()
+        except BaseException:
+            pass
+        finally:
+            done.set()
+
+    t = threading.Thread(target=doomed, daemon=True)
+    t.start()
+    snap = tmp_path / "resume_exp" / "tuner.pkl"
+    deadline = time.time() + 60
+    while time.time() < deadline and not snap.exists():
+        time.sleep(0.1)
+    assert snap.exists(), "snapshot should appear during the sweep"
+    time.sleep(2.0)  # let some progress accumulate into a snapshot
+
+    # capture the MID-RUN snapshot (trials still RUNNING inside it) —
+    # the doomed fit's final snapshot would mark everything TERMINATED
+    # and never exercise the resume path
+    import shutil
+
+    crash_dir = tmp_path / "crash_copy"
+    crash_dir.mkdir()
+    shutil.copy(snap, crash_dir / "tuner.pkl")
+
+    marker.unlink()  # fast mode for the resumed run
+    done.wait(timeout=120)  # let the doomed run finish to free actors
+
+    restored = tune.Tuner.restore(str(crash_dir), trainable)
+    grid = restored.fit()
+    assert len(grid) == 4
+    assert sorted(r.metrics["i"] for r in grid) == [5, 5, 5, 5]
+    # the resume path must actually have run: at least one trial was
+    # restarted FROM A CHECKPOINT (its post-resume reports carry start>0)
+    resumed_starts = [
+        m["start"]
+        for r in grid
+        for m in r.metrics_history
+        if m.get("start", 0) > 0
+    ]
+    assert resumed_starts, "no trial resumed from a checkpoint"
