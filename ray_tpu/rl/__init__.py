@@ -4,11 +4,16 @@ Reference: ``rllib/`` new API stack (Algorithm / EnvRunnerGroup /
 LearnerGroup). See ``ppo.py`` for the TPU-native design notes."""
 
 from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.learner_group import LearnerGroup
 from ray_tpu.rl.models import apply_mlp_policy, init_mlp_policy
 from ray_tpu.rl.ppo import PPO, PPOConfig
 
 __all__ = [
     "EnvRunner",
+    "IMPALA",
+    "IMPALAConfig",
+    "LearnerGroup",
     "PPO",
     "PPOConfig",
     "apply_mlp_policy",

@@ -1,0 +1,286 @@
+"""IMPALA: asynchronous sampling with V-trace off-policy correction.
+
+Reference: ``rllib/algorithms/impala/impala.py:143`` — EnvRunners sample
+CONTINUOUSLY with slightly-stale weights; rollouts stream to the learner
+as they arrive (no synchronous barrier like PPO); V-trace corrects for
+the policy lag. The reference's aggregator actors batch rollouts ahead
+of GPU learners; here the aggregation is the ready-set drain each
+``train()`` pass and the learner is a jitted V-trace update — run
+locally, or across a ``LearnerGroup`` gang (one pjit program, batch
+sharded over learners) when ``num_learners > 1``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.models import apply_mlp_policy, init_mlp_policy
+
+
+@dataclass
+class IMPALAConfig:
+    env: str = "CartPole-v1"
+    env_config: Optional[Dict[str, Any]] = None
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_fragment_length: int = 64
+    gamma: float = 0.99
+    vtrace_clip_rho: float = 1.0
+    vtrace_clip_c: float = 1.0
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    lr: float = 5e-4
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    #: rollout fragments consumed (= learner updates) per train() call
+    rollouts_per_iteration: int = 8
+    #: learner gang size; >1 runs the update as one pjit program over a
+    #: LearnerGroup (CPU gang in tests, chips in production)
+    num_learners: int = 1
+    learner_platform: Optional[str] = "cpu"
+    runner_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 0.5})
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+def _make_vtrace_update(cfg: IMPALAConfig, obs_dim: int, num_actions: int):
+    """Builds ``update(state, batch) -> (state, stats)`` — pure jax, so
+    it can be jitted locally or shipped to a LearnerGroup. Batch layout
+    is [B, T, ...] (batch-major so a gang shards envs, not time)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.adam(cfg.lr)
+
+    def vtrace(values, rewards, dones, rhos, bootstrap):
+        """V-trace targets (IMPALA paper eq. 1) via a reverse scan over
+        time. Shapes [B, T]; bootstrap [B]."""
+        rho = jnp.minimum(rhos, cfg.vtrace_clip_rho)
+        c = jnp.minimum(rhos, cfg.vtrace_clip_c)
+        nonterminal = 1.0 - dones
+        next_values = jnp.concatenate(
+            [values[:, 1:], bootstrap[:, None]], axis=1
+        )
+        deltas = rho * (rewards + cfg.gamma * next_values * nonterminal - values)
+
+        def step(carry, xs):
+            delta_t, c_t, nt_t = xs
+            carry = delta_t + cfg.gamma * nt_t * c_t * carry
+            return carry, carry
+
+        # scan over time reversed (time axis moved to front for the scan)
+        xs = (
+            jnp.moveaxis(deltas, 1, 0)[::-1],
+            jnp.moveaxis(c, 1, 0)[::-1],
+            jnp.moveaxis(nonterminal, 1, 0)[::-1],
+        )
+        _, acc = jax.lax.scan(step, jnp.zeros_like(bootstrap), xs)
+        vs_minus_v = jnp.moveaxis(acc[::-1], 0, 1)
+        vs = values + vs_minus_v
+        next_vs = jnp.concatenate([vs[:, 1:], bootstrap[:, None]], axis=1)
+        pg_adv = rho * (rewards + cfg.gamma * next_vs * nonterminal - values)
+        return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+    def loss_fn(params, batch):
+        B, T = batch["actions"].shape
+        flat_obs = batch["obs"].reshape(B * T, -1)
+        logits, values = apply_mlp_policy(params, flat_obs)
+        logits = logits.reshape(B, T, -1)
+        values = values.reshape(B, T)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        rhos = jnp.exp(logp - batch["behavior_logp"])
+        vs, pg_adv = vtrace(
+            values,
+            batch["rewards"],
+            batch["dones"].astype(jnp.float32),
+            rhos,
+            batch["bootstrap"],
+        )
+        pi_loss = -(logp * pg_adv).mean()
+        vf_loss = ((values - vs) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pi_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy
+        return total, (pi_loss, vf_loss, entropy)
+
+    def update(state, batch):
+        params, opt_state = state
+        (total, (pi_loss, vf_loss, entropy)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), {
+            "loss": total,
+            "pi_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+    def init_state():
+        import jax as _jax
+
+        params = init_mlp_policy(
+            _jax.random.PRNGKey(cfg.seed), obs_dim, num_actions, cfg.hidden
+        )
+        return (params, optimizer.init(params))
+
+    return init_state, update
+
+
+class IMPALA:
+    """Async sample → V-trace learn loop (Tune-trainable surface)."""
+
+    def __init__(self, config: IMPALAConfig):
+        import jax
+
+        from ray_tpu.rl.utils import make_runners, probe_env_spec
+
+        self.config = config
+        obs_dim, num_actions = probe_env_spec(config.env, config.env_config)
+
+        init_state, update = _make_vtrace_update(config, obs_dim, num_actions)
+        self._group = None
+        if config.num_learners > 1:
+            from ray_tpu.rl.learner_group import LearnerGroup
+
+            self._group = LearnerGroup(
+                num_learners=config.num_learners,
+                init_fn=init_state,
+                update_builder=lambda: update,
+                platform=config.learner_platform,
+            )
+            self._state = None
+        else:
+            self._state = init_state()
+            self._update = jax.jit(update)
+        self.iteration = 0
+        self._recent_returns: List[float] = []
+
+        self.runners = make_runners(config)
+        #: runner index -> in-flight sample ref (the ASYNC loop: runners
+        #: never wait for the learner)
+        self._inflight: Dict[int, Any] = {}
+
+    # -- weights ---------------------------------------------------------
+    def _params(self):
+        if self._group is not None:
+            return self._group.get_params()
+        return self._state[0]
+
+    def _dispatch(self, i: int, params) -> None:
+        self._inflight[i] = self.runners[i].sample.remote(
+            params, self.config.rollout_fragment_length
+        )
+
+    # -- one training iteration -----------------------------------------
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        params = self._params()
+        for i in range(len(self.runners)):
+            if i not in self._inflight:
+                self._dispatch(i, params)
+
+        stats: Dict[str, float] = {}
+        steps = 0
+        consumed = 0
+        # consume a budget of fragments, one learner update each; the
+        # runners stay busy throughout (async: a fragment is re-dispatched
+        # the moment it's consumed, with the freshest weights)
+        while consumed < cfg.rollouts_per_iteration:
+            refs = list(self._inflight.values())
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=300)
+            if not ready:
+                # a hung EnvRunner must surface, not spin this loop forever
+                raise ray_tpu.GetTimeoutError(
+                    "no rollout completed within 300s (hung env runner?)"
+                )
+            ready_set = {r.binary() for r in ready}
+            more, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+            ready_set |= {r.binary() for r in more}
+            for i, ref in list(self._inflight.items()):
+                if ref.binary() not in ready_set:
+                    continue
+                rollout = ray_tpu.get(ref, timeout=300)
+                del self._inflight[i]
+                batch = self._to_batch(rollout)
+                steps += batch["actions"].size
+                if self._group is not None:
+                    stats = self._group.update(batch)
+                    params = self._group.get_params()
+                else:
+                    self._state, jstats = self._update(self._state, batch)
+                    stats = {k: float(v) for k, v in jstats.items()}
+                    params = self._state[0]
+                self._recent_returns.extend(rollout["episode_returns"])
+                consumed += 1
+                # IMPALA weight broadcast: staleness = one fragment
+                self._dispatch(i, params)
+
+        self.iteration += 1
+        self._recent_returns = self._recent_returns[-100:]
+        mean_return = (
+            float(np.mean(self._recent_returns)) if self._recent_returns else 0.0
+        )
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_return,
+            "num_env_steps_trained": steps,
+            "iter_time_s": round(time.perf_counter() - t0, 3),
+            **stats,
+        }
+
+    @staticmethod
+    def _to_batch(rollout) -> Dict[str, np.ndarray]:
+        """[T, N, ...] rollout → batch-major [N, T, ...] arrays (a gang
+        shards on envs, never mid-trajectory)."""
+        return {
+            "obs": np.moveaxis(rollout["obs"], 0, 1).astype(np.float32),
+            "actions": np.moveaxis(rollout["actions"], 0, 1).astype(np.int32),
+            "behavior_logp": np.moveaxis(rollout["logp"], 0, 1).astype(np.float32),
+            "rewards": np.moveaxis(rollout["rewards"], 0, 1).astype(np.float32),
+            "dones": np.moveaxis(rollout["dones"], 0, 1).astype(np.float32),
+            "bootstrap": rollout["last_values"].astype(np.float32),
+        }
+
+    # -- Tune/state surface ---------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+
+        state = self._group.get_state() if self._group is not None else self._state
+        return {
+            "state": jax.tree_util.tree_map(np.asarray, state),
+            "iteration": self.iteration,
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        if self._group is not None:
+            self._group.set_state(state["state"])
+        else:
+            self._state = state["state"]
+        self.iteration = state["iteration"]
+
+    def compute_single_action(self, obs) -> int:
+        from ray_tpu.rl.utils import greedy_action
+
+        return greedy_action(self._params(), obs)
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.get(r.close.remote(), timeout=10)
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        if self._group is not None:
+            self._group.shutdown()

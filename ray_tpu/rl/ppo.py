@@ -20,7 +20,6 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.env_runner import EnvRunner
 from ray_tpu.rl.models import apply_mlp_policy, init_mlp_policy
 
 
@@ -53,15 +52,13 @@ class PPO:
     """EnvRunner gang + jitted JAX learner (reference Algorithm)."""
 
     def __init__(self, config: PPOConfig):
-        import gymnasium as gym
         import jax
         import optax
 
+        from ray_tpu.rl.utils import make_runners, probe_env_spec
+
         self.config = config
-        probe = gym.make(config.env, **(config.env_config or {}))
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
-        probe.close()
+        obs_dim, num_actions = probe_env_spec(config.env, config.env_config)
 
         rng = jax.random.PRNGKey(config.seed)
         self.params = init_mlp_policy(rng, obs_dim, num_actions, config.hidden)
@@ -70,21 +67,7 @@ class PPO:
         self.iteration = 0
         self._update = jax.jit(self._make_update())
 
-        self.runners = [
-            EnvRunner.options(
-                num_cpus=config.runner_resources.get("CPU", 0.5),
-                resources={
-                    k: v for k, v in config.runner_resources.items() if k != "CPU"
-                }
-                or None,
-            ).remote(
-                config.env,
-                config.num_envs_per_runner,
-                config.seed + 1000 * i,
-                config.env_config,
-            )
-            for i in range(config.num_env_runners)
-        ]
+        self.runners = make_runners(config)
         self._recent_returns: List[float] = []
 
     # -- learner ---------------------------------------------------------
@@ -225,10 +208,9 @@ class PPO:
 
     def compute_single_action(self, obs) -> int:
         """Greedy action for evaluation."""
-        import jax.numpy as jnp
+        from ray_tpu.rl.utils import greedy_action
 
-        logits, _ = apply_mlp_policy(self.params, jnp.asarray(obs, jnp.float32)[None])
-        return int(np.argmax(np.asarray(logits)[0]))
+        return greedy_action(self.params, obs)
 
     def stop(self) -> None:
         for r in self.runners:

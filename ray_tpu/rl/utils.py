@@ -1,0 +1,53 @@
+"""Shared algorithm plumbing (PPO + IMPALA).
+
+Reference: the pieces ``rllib/algorithms/algorithm.py`` provides to every
+algorithm — env-space probing, the EnvRunnerGroup construction, greedy
+evaluation actions."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.models import apply_mlp_policy
+
+
+def probe_env_spec(env: str, env_config: Optional[Dict[str, Any]]) -> Tuple[int, int]:
+    """(obs_dim, num_actions) from one throwaway env instance."""
+    import gymnasium as gym
+
+    probe = gym.make(env, **(env_config or {}))
+    obs_dim = int(np.prod(probe.observation_space.shape))
+    num_actions = int(probe.action_space.n)
+    probe.close()
+    return obs_dim, num_actions
+
+
+def make_runners(config) -> List[Any]:
+    """The EnvRunner gang from any config carrying env/num_env_runners/
+    num_envs_per_runner/seed/env_config/runner_resources."""
+    return [
+        EnvRunner.options(
+            num_cpus=config.runner_resources.get("CPU", 0.5),
+            resources={
+                k: v for k, v in config.runner_resources.items() if k != "CPU"
+            }
+            or None,
+        ).remote(
+            config.env,
+            config.num_envs_per_runner,
+            config.seed + 1000 * i,
+            config.env_config,
+        )
+        for i in range(config.num_env_runners)
+    ]
+
+
+def greedy_action(params, obs) -> int:
+    """Deterministic evaluation action."""
+    import jax.numpy as jnp
+
+    logits, _ = apply_mlp_policy(params, jnp.asarray(obs, jnp.float32)[None])
+    return int(np.argmax(np.asarray(logits)[0]))

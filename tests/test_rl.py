@@ -89,3 +89,143 @@ def test_ppo_state_roundtrip(cluster):
             algo2.stop()
     finally:
         algo.stop()
+
+
+def test_learner_group_matches_single_process(cluster):
+    """A 2-process LearnerGroup update (one pjit program, batch sharded
+    over the gang) must be numerically IDENTICAL to a single-process
+    update on the whole batch (reference learner_group.py:81 DDP
+    equivalence)."""
+    import cloudpickle  # noqa: F401 — exercised via the group
+
+    from ray_tpu.rl.learner_group import LearnerGroup
+
+    def init_fn():
+        import jax
+        import jax.numpy as jnp
+
+        k = jax.random.PRNGKey(0)
+        w = jax.random.normal(k, (4, 1))
+        return (w, jnp.zeros((4, 1)))
+
+    def update_builder():
+        import jax
+        import jax.numpy as jnp
+
+        def update(state, batch):
+            w, m = state
+
+            def loss_fn(w):
+                pred = batch["x"] @ w
+                return ((pred - batch["y"]) ** 2).mean()
+
+            loss, g = jax.value_and_grad(loss_fn)(w)
+            m = 0.9 * m + g
+            w = w - 0.1 * m
+            return (w, m), {"loss": loss}
+
+        return update
+
+    rng = np.random.default_rng(3)
+    batch = {
+        "x": rng.standard_normal((16, 4)).astype(np.float32),
+        "y": rng.standard_normal((16, 1)).astype(np.float32),
+    }
+
+    group = LearnerGroup(
+        num_learners=2, init_fn=init_fn, update_builder=update_builder
+    )
+    try:
+        stats2 = [group.update(batch) for _ in range(3)]
+        w2 = group.get_state()[0]
+    finally:
+        group.shutdown()
+
+    single = LearnerGroup(
+        num_learners=1, init_fn=init_fn, update_builder=update_builder
+    )
+    try:
+        stats1 = [single.update(batch) for _ in range(3)]
+        w1 = single.get_state()[0]
+    finally:
+        single.shutdown()
+
+    for s1, s2 in zip(stats1, stats2):
+        assert abs(s1["loss"] - s2["loss"]) < 1e-5, (s1, s2)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w2), rtol=1e-5)
+
+
+def test_impala_learns_cartpole(cluster):
+    """IMPALA learning test (reference rllib learning-test pattern):
+    async V-trace updates must clearly improve the mean return."""
+    from ray_tpu.rl import IMPALAConfig
+
+    algo = IMPALAConfig(
+        num_env_runners=2,
+        num_envs_per_runner=4,
+        rollout_fragment_length=128,
+        lr=1e-3,
+        seed=1,
+    ).build()
+    try:
+        first = algo.train()["episode_return_mean"]
+        last = first
+        for _ in range(30):
+            last = algo.train()["episode_return_mean"]
+            if last >= 60.0:
+                break
+        assert last >= 60.0 or last >= 2.5 * max(first, 15.0), (first, last)
+    finally:
+        algo.stop()
+
+
+def test_impala_state_roundtrip(cluster):
+    from ray_tpu.rl import IMPALAConfig
+
+    algo = IMPALAConfig(
+        num_env_runners=1, num_envs_per_runner=2, rollout_fragment_length=32,
+        seed=2,
+    ).build()
+    try:
+        algo.train()
+        state = algo.get_state()
+        obs = np.zeros(4, np.float32)
+        before = algo.compute_single_action(obs)
+        algo2 = IMPALAConfig(
+            num_env_runners=1, num_envs_per_runner=2,
+            rollout_fragment_length=32, seed=3,
+        ).build()
+        try:
+            algo2.set_state(state)
+            assert algo2.compute_single_action(obs) == before
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
+
+
+def test_impala_with_learner_gang(cluster):
+    """IMPALA over a 2-process LearnerGroup: the V-trace update ships to
+    the gang as one pjit program (batch sharded over envs) and training
+    still progresses + round-trips state."""
+    from ray_tpu.rl import IMPALAConfig
+
+    algo = IMPALAConfig(
+        num_env_runners=1,
+        num_envs_per_runner=4,  # divisible by the gang size
+        rollout_fragment_length=32,
+        rollouts_per_iteration=2,
+        num_learners=2,
+        seed=5,
+    ).build()
+    try:
+        out = algo.train()
+        assert out["num_env_steps_trained"] > 0
+        assert "loss" in out
+        obs = np.zeros(4, np.float32)
+        state = algo.get_state()
+        before = algo.compute_single_action(obs)
+        algo.set_state(state)
+        assert algo.compute_single_action(obs) == before
+    finally:
+        algo.stop()
