@@ -7,7 +7,7 @@ The flagship model path (SURVEY §7 step 7 north star). Design:
     ``ShardingRules`` table (``ray_tpu.parallel.sharding``) maps them to
     mesh axes, so DP/FSDP/TP/SP re-parallelization is a table swap;
   * attention is ``ray_tpu.ops.flash_attention`` (pallas on TPU, XLA
-    fallback elsewhere), GQA via KV-head repeat;
+    fallback elsewhere), GQA mapped in-kernel (K/V stay at n_kv_heads);
   * bf16-friendly: matmuls in the param dtype, softmax/logits/loss in
     fp32 (MXU wants bf16 inputs + f32 accumulation).
 
@@ -249,9 +249,8 @@ def _attention_block(cfg: LlamaConfig, p, x, cos, sin, mesh=None):
         else:
             o = ulysses_attention_sharded(qt, kt, vt, mesh, causal=True)
     else:
-        if rep > 1:
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # GQA K/V stay at n_kv_heads — the flash kernel maps q-head →
+        # kv-head in its index map, so the repeat never touches HBM.
         qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         o = flash_attention(qt, kt, vt, causal=True, impl=cfg.attention_impl)
     o = o.transpose(0, 2, 1, 3)  # [B, S, H, hd]

@@ -34,9 +34,15 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-DEFAULT_BLOCK_Q = 512  # tuned on v5e: (512, 1024) wins at s=2048..8192
-DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
+
+
+def default_blocks(seq_q: int) -> tuple:
+    """Tuned on v5e (round-5 sweep, fwd+bwd which is what training
+    runs): (512, 1024) wins at s=2048 (67 vs 57 TFLOP/s) AND s=8192
+    (61 vs 56). Forward-only favors (1024, 512) at long seq by ~10%,
+    but a split default would desync the custom_vjp's fwd/bwd blocks."""
+    return (512, 1024)
 
 
 def _pick_block(seq: int, want: int) -> Optional[int]:
@@ -83,6 +89,23 @@ def _causal_mask(s, qb, kb, block_q: int, block_k: int):
     return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
 
+def _apply_causal_mask(s, qb, kb, block_q: int, block_k: int, num_inner: int):
+    """Shared masking policy for all three kernels. Tiles strictly below
+    the diagonal need no mask; branching per tile (lax.cond) only pays
+    off when diagonal tiles are a small fraction of the work (>=8 inner
+    tiles — measured on v5e); below that the branch overhead exceeds the
+    saved iota/compare/select."""
+    if num_inner >= 8:
+        on_diag = (kb + 1) * block_k > qb * block_q
+        return jax.lax.cond(
+            on_diag,
+            lambda s: _causal_mask(s, qb, kb, block_q, block_k),
+            lambda s: s,
+            s,
+        )
+    return _causal_mask(s, qb, kb, block_q, block_k)
+
+
 # ---------------------------------------------------------------------------
 # forward kernel — grid (bh, num_q, num_k), K innermost ("arbitrary")
 # ---------------------------------------------------------------------------
@@ -106,14 +129,17 @@ def _fwd_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        kblk = k_ref[0].astype(jnp.float32)
-        vblk = v_ref[0].astype(jnp.float32)
+        # Matmul inputs stay in their storage dtype (bf16 on TPU runs the
+        # MXU at full rate; an fp32 upcast would quarter it) — fp32 comes
+        # from the accumulator via preferred_element_type.
+        q = q_ref[0]
+        kblk = k_ref[0]
+        vblk = v_ref[0]
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        ) * sm_scale  # [bq, bk] fp32
         if causal:
-            s = _causal_mask(s, qb, kb, block_q, block_k)
+            s = _apply_causal_mask(s, qb, kb, block_q, block_k, num_kb)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -122,7 +148,8 @@ def _fwd_kernel(
         m_ref[...] = m_new
         l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(kb == num_kb - 1)
@@ -132,7 +159,18 @@ def _fwd_kernel(
         lse_ref[0] = m_ref[...] + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int):
+def _kv_head_map(h: int, hk: int):
+    """Flattened (batch*q_head) grid index → flattened (batch*kv_head)
+    K/V block index. GQA never materializes repeated K/V — the index map
+    re-reads the shared head (Pallas skips the DMA when the block index
+    repeats across consecutive q-heads)."""
+    if h == hk:
+        return lambda bh: bh
+    group = h // hk
+    return lambda bh: (bh // h) * hk + (bh % h) // group
+
+
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, h: int, hk: int):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -141,13 +179,14 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     num_qb = seq_q // block_q
     num_kb = seq_k // block_k
     grid = (bh, num_qb, num_kb)
+    kvh = _kv_head_map(h, hk)
 
     if causal:
         # Clamp the K tile index at this Q tile's diagonal: repeated block
         # indices skip the DMA, so masked-out tiles cost no bandwidth.
-        kv_idx = lambda b, i, j: (b, jnp.minimum(j, _last_kb(i, block_q, block_k, num_kb)), 0)
+        kv_idx = lambda b, i, j: (kvh(b), jnp.minimum(j, _last_kb(i, block_q, block_k, num_kb)), 0)
     else:
-        kv_idx = lambda b, i, j: (b, j, 0)
+        kv_idx = lambda b, i, j: (kvh(b), j, 0)
 
     out_shape = [
         jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -208,22 +247,22 @@ def _bwd_dq_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
-        kblk = k_ref[0].astype(jnp.float32)
-        vblk = v_ref[0].astype(jnp.float32)
+        kblk = k_ref[0]
+        vblk = v_ref[0]
         s = jax.lax.dot_general(
-            q * sm_scale, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
         if causal:
-            s = _causal_mask(s, qb, kb, block_q, block_k)
+            s = _apply_causal_mask(s, qb, kb, block_q, block_k, num_kb)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(kblk.dtype)
         dq_acc_ref[...] += jax.lax.dot_general(
             ds, kblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -238,12 +277,15 @@ def _bwd_dkv_kernel(
     dk_acc_ref, dv_acc_ref,
     *, block_q: int, block_k: int, num_qb: int, causal: bool, sm_scale: float,
 ):
+    # Inner grid dim is (group * num_qb): for GQA each kv head's dk/dv
+    # accumulates over every q head in its group before the final write.
     from jax.experimental import pallas as pl
 
     kb = pl.program_id(1)
-    qb = pl.program_id(2)
+    inner = pl.program_id(2)
+    qb = inner % num_qb
 
-    @pl.when(qb == 0)
+    @pl.when(inner == 0)
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
@@ -252,36 +294,37 @@ def _bwd_dkv_kernel(
 
     @pl.when(run)
     def _compute():
-        kblk = k_ref[0].astype(jnp.float32)
-        vblk = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        kblk = k_ref[0]
+        vblk = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jax.lax.dot_general(
-            q * sm_scale, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
         if causal:
-            s = _causal_mask(s, qb, kb, block_q, block_k)
+            s = _apply_causal_mask(s, qb, kb, block_q, block_k, num_qb)
         p = jnp.exp(s - lse)  # [bq, bk]
         dv_acc_ref[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk_acc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qb == num_qb - 1)
+    @pl.when(inner == pl.num_programs(2) - 1)
     def _finalize():
         dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+def _flash_bwd(causal, sm_scale, block_q, block_k, h, hk, res, g):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -291,14 +334,16 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
     seq_k = k.shape[1]
     num_qb = seq_q // block_q
     num_kb = seq_k // block_k
+    group = h // hk
+    kvh = _kv_head_map(h, hk)
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )  # [bh, seq_q, 1]
 
     if causal:
-        kv_idx = lambda b, i, j: (b, jnp.minimum(j, _last_kb(i, block_q, block_k, num_kb)), 0)
+        kv_idx = lambda b, i, j: (kvh(b), jnp.minimum(j, _last_kb(i, block_q, block_k, num_kb)), 0)
     else:
-        kv_idx = lambda b, i, j: (b, j, 0)
+        kv_idx = lambda b, i, j: (kvh(b), j, 0)
     q_idx = lambda b, i, j: (b, i, 0)
 
     dq = pl.pallas_call(
@@ -325,14 +370,20 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
 
+    # dkv pass runs over KV heads; the inner dim walks every q head in
+    # the GQA group × every q tile. bkv → base q-head block for the group.
+    def q_head_base(bkv):
+        return (bkv // hk) * h + (bkv % hk) * group if h != hk else bkv
+
     if causal:
         # Clamp the Q tile index from below at the diagonal: tiles above
         # it contribute nothing to this K tile's dk/dv.
         qd_idx = lambda b, j, i: (
-            b, jnp.maximum(i, (j * block_k) // block_q), 0
+            q_head_base(b) + i // num_qb,
+            jnp.maximum(i % num_qb, (j * block_k) // block_q), 0,
         )
     else:
-        qd_idx = lambda b, j, i: (b, i, 0)
+        qd_idx = lambda b, j, i: (q_head_base(b) + i // num_qb, i % num_qb, 0)
     kv2_idx = lambda b, j, i: (b, j, 0)
 
     dk, dv = pl.pallas_call(
@@ -341,7 +392,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
             block_q=block_q, block_k=block_k, num_qb=num_qb,
             causal=causal, sm_scale=sm_scale,
         ),
-        grid=(bh, num_kb, num_qb),
+        grid=(k.shape[0], num_kb, group * num_qb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), qd_idx),
             pl.BlockSpec((1, block_k, d), kv2_idx),
@@ -374,19 +425,19 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash3(q, k, v, causal, sm_scale, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash3(q, k, v, causal, sm_scale, block_q, block_k, h, hk):
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, h, hk)
     return o
 
 
-def _flash3_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+def _flash3_fwd(q, k, v, causal, sm_scale, block_q, block_k, h, hk):
+    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, h, hk)
     return o, (q, k, v, o, lse)
 
 
-def _flash3_bwd(causal, sm_scale, block_q, block_k, res, g):
-    return _flash_bwd(causal, sm_scale, block_q, block_k, res, g)
+def _flash3_bwd(causal, sm_scale, block_q, block_k, h, hk, res, g):
+    return _flash_bwd(causal, sm_scale, block_q, block_k, h, hk, res, g)
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
@@ -399,34 +450,46 @@ def flash_attention(
     *,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     impl: str = "auto",
 ):
-    """Multi-head attention. q/k/v: ``[batch, heads, seq, head_dim]``.
+    """Multi-head attention. q: ``[batch, heads, seq, head_dim]``;
+    k/v: ``[batch, kv_heads, seq, head_dim]`` where ``heads`` is a
+    multiple of ``kv_heads`` — GQA is handled *inside* the kernel by
+    mapping each q head's K/V block index onto its shared kv head, so
+    repeated K/V never hits HBM (reference pattern: KV-repeat before
+    torch SDPA; here the index map replaces the repeat).
 
     ``impl``: "pallas" (flash kernel), "xla" (reference), or "auto"
     (pallas on TPU, xla elsewhere — CI still covers the kernel through
-    interpret-mode tests). GQA: repeat kv heads before calling.
+    interpret-mode tests).
     """
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    b, h, seq_q, d = q.shape
+    hk = k.shape[1]
+    if h % hk:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({hk})")
     if impl == "xla":
+        if hk != h:
+            k = jnp.repeat(k, h // hk, axis=1)
+            v = jnp.repeat(v, h // hk, axis=1)
         return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
 
-    b, h, seq_q, d = q.shape
     seq_k = k.shape[2]
-    block_q = _pick_block(seq_q, block_q)
-    block_k = _pick_block(seq_k, block_k)
+    dbq, dbk = default_blocks(seq_q)
+    block_q = _pick_block(seq_q, block_q or dbq)
+    block_k = _pick_block(seq_k, block_k or dbk)
     if block_q is None or block_k is None:
         raise ValueError(
             f"sequence lengths ({seq_q}, {seq_k}) have no block divisor "
             f"≥128 — pad the sequence to a multiple of 128"
         )
     qf = q.reshape(b * h, seq_q, d)
-    kf = k.reshape(b * h, seq_k, d)
-    vf = v.reshape(b * h, seq_k, d)
-    o = _flash3(qf, kf, vf, causal, sm_scale, block_q, block_k)
+    kf = k.reshape(b * hk, seq_k, d)
+    vf = v.reshape(b * hk, seq_k, d)
+    o = _flash3(qf, kf, vf, causal, sm_scale, block_q, block_k, h, hk)
     return o.reshape(b, h, seq_q, d)

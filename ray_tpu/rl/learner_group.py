@@ -95,9 +95,20 @@ class _LearnerWorker:
 
         init_fn = cloudpickle.loads(init_fn_b)
         update = cloudpickle.loads(update_builder_b)()
-        devices = np.array(jax.devices())
-        self._mesh = Mesh(devices, ("dp",))
-        self._batch_sharding = NamedSharding(self._mesh, P("dp"))
+        # The mesh's outer axis is sized by the GANG (one row per learner
+        # process), not by len(jax.devices()): a host-device mesh of
+        # world*8 CPU devices must not demand a batch divisible by 16
+        # when there are 2 learners feeding 2-row shards. Each learner's
+        # local devices form an inner axis that ALSO data-parallelizes
+        # when the batch divides (P(("dp","repl"))), falling back to
+        # per-process replication for small batches.
+        devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        per_proc = len(devices) // self._world
+        mesh_devices = np.array(devices).reshape(self._world, per_proc)
+        self._mesh = Mesh(mesh_devices, ("dp", "repl"))
+        self._full_sharding = NamedSharding(self._mesh, P(("dp", "repl")))
+        self._proc_sharding = NamedSharding(self._mesh, P("dp"))
+        self._n_devices = len(devices)
         self._state = init_fn()  # plain host arrays, identical per rank
         self._update = jax.jit(update)
         return True
@@ -105,11 +116,15 @@ class _LearnerWorker:
     def _global_batch(self, local_batch: Dict[str, np.ndarray]):
         import jax
 
+        rows = len(next(iter(local_batch.values()))) * self._world
+        sharding = (
+            self._full_sharding if rows % self._n_devices == 0
+            else self._proc_sharding
+        )
+
         def to_global(x):
             x = np.asarray(x)
-            return jax.make_array_from_process_local_data(
-                self._batch_sharding, x
-            )
+            return jax.make_array_from_process_local_data(sharding, x)
 
         return {k: to_global(v) for k, v in local_batch.items()}
 
