@@ -154,6 +154,16 @@ class ActorHandle:
         spec.seq_no = self._next_seq()
         spec.concurrency_group = opts.get("concurrency_group")
         spec.max_concurrency = self._max_concurrency  # dispatch-path hint
+        if spec.num_returns == "streaming":
+            # generator method: items stream back over the push connection
+            # exactly like normal streaming tasks (task_manager.h:102)
+            from ray_tpu.core.streaming import ObjectRefGenerator
+
+            worker.backend.create_stream(spec)
+            worker.backend.submit_actor_task(spec)
+            return ObjectRefGenerator(
+                worker.backend, spec.task_id.binary(), worker.address
+            )
         worker.backend.submit_actor_task(spec)
         refs = [ObjectRef(oid, worker.address) for oid in spec.return_ids]
         worker.backend.release_hold(spec.return_ids)

@@ -280,10 +280,10 @@ class Worker:
         if isinstance(num_returns, int):
             return_ids = [ObjectID.from_index(task_id, i + 1) for i in range(num_returns)]
         elif num_returns == "streaming":
-            if kind != TaskKind.NORMAL:
+            if kind not in (TaskKind.NORMAL, TaskKind.ACTOR_TASK):
                 raise ValueError(
-                    'num_returns="streaming" is only supported on normal '
-                    "tasks (not actor methods) in this build"
+                    'num_returns="streaming" is only supported on tasks '
+                    "and actor methods"
                 )
             return_ids = []  # item ids are generated as the task yields
         else:

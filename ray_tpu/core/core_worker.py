@@ -1304,6 +1304,14 @@ class CoreWorker(RuntimeBackend):
                         )
                     return
                 client = self._client(st.address.host, st.address.port)
+                for s in batch:
+                    # streaming methods need the producer's address for
+                    # consumer-position (backpressure) reports
+                    if s.num_returns == "streaming":
+                        self._inflight_workers[s.task_id.binary()] = (
+                            st.address.host,
+                            st.address.port,
+                        )
                 try:
                     reply = await client.call(
                         "push_batch", {"specs": batch}, timeout=None, connect_timeout=3.0
@@ -1328,7 +1336,12 @@ class CoreWorker(RuntimeBackend):
                     survivors: List[TaskSpec] = []
                     for s in batch:
                         tid = s.task_id.binary()
-                        if st.state == "DEAD" or retries_left[tid] <= 0:
+                        # a partially-consumed stream must not replay
+                        if (
+                            st.state == "DEAD"
+                            or retries_left[tid] <= 0
+                            or s.num_returns == "streaming"
+                        ):
                             self._fail_returns(
                                 s,
                                 ActorDiedError(
@@ -1362,6 +1375,7 @@ class CoreWorker(RuntimeBackend):
         finally:
             for s in all_specs:
                 self._unpin_deps(s)
+                self._inflight_workers.pop(s.task_id.binary(), None)
 
     async def _submit_actor_inner(self, spec: TaskSpec) -> None:
         try:
@@ -1376,6 +1390,11 @@ class CoreWorker(RuntimeBackend):
                     )
                     return
                 client = self._client(st.address.host, st.address.port)
+                if spec.num_returns == "streaming":
+                    self._inflight_workers[spec.task_id.binary()] = (
+                        st.address.host,
+                        st.address.port,
+                    )
                 try:
                     reply = await client.call("push_task", {"spec": spec}, timeout=None, connect_timeout=3.0)
                 except ConnectionLost:
@@ -1388,7 +1407,11 @@ class CoreWorker(RuntimeBackend):
                             st.reason = info.get("reason", "")
                         else:
                             st.state = "DEAD"
-                    if st.state == "DEAD" or retries_left <= 0:
+                    if (
+                        st.state == "DEAD"
+                        or retries_left <= 0
+                        or spec.num_returns == "streaming"
+                    ):
                         self._fail_returns(
                             spec,
                             ActorDiedError(
@@ -1404,6 +1427,7 @@ class CoreWorker(RuntimeBackend):
                 return
         finally:
             self._unpin_deps(spec)
+            self._inflight_workers.pop(spec.task_id.binary(), None)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
         self.io.run(

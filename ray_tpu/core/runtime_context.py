@@ -19,7 +19,9 @@ class RuntimeContext:
         return self._worker.current_task_id.hex()
 
     def get_actor_id(self) -> Optional[str]:
-        aid = getattr(self._worker._context, "actor_id", None)
+        """Hex id of the actor this process hosts (None outside actors).
+        Set by the executor at actor creation (task_executor)."""
+        aid = getattr(self._worker, "current_actor_id", None)
         return aid.hex() if aid is not None else None
 
     def get_node_id(self) -> Optional[str]:
@@ -31,4 +33,4 @@ class RuntimeContext:
         return self._worker.namespace
 
     def get_assigned_resources(self):
-        return getattr(self._worker._context, "resources", {})
+        return getattr(self._worker, "assigned_resources", {})

@@ -101,10 +101,21 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self):
+        return self.next_with_timeout(None)
+
+    def next_with_timeout(self, timeout):
+        """Like ``__next__`` but bounded: raises TimeoutError if no item
+        (or end-of-stream) arrives in ``timeout`` seconds — what lets a
+        serving router cap time-to-first-token instead of parking
+        forever on a stuck producer."""
         from ray_tpu.core.refs import ObjectRef
 
         self._pos += 1
-        oid = self._backend.stream_next(self._task_id, self._pos, timeout=None)
+        try:
+            oid = self._backend.stream_next(self._task_id, self._pos, timeout=timeout)
+        except Exception:
+            self._pos -= 1  # not consumed — a retry re-requests this index
+            raise
         if oid is _END:
             raise StopIteration
         ref = ObjectRef(oid, self._owner)

@@ -91,6 +91,8 @@ class TaskExecutor:
 
         def _create():
             self.api_worker.job_id = spec.job_id
+            self.api_worker.current_actor_id = spec.actor_id
+            self.api_worker.assigned_resources = dict(spec.resources or {})
             self.api_worker.set_task_context(spec.task_id, spec.job_id)
             # dedicated worker: runtime-env vars apply for its lifetime
             self._apply_runtime_env(spec)
@@ -120,23 +122,29 @@ class TaskExecutor:
         return {"ok": True}
 
     # ------------------------------------------------------------------
+    def _make_emit(self, spec: TaskSpec, conn):
+        """Stream items push back over the submission connection, ordered
+        by TCP (reference: generator returns stream through the reply
+        channel, _raylet.pyx:1345). Shared by the normal-task and
+        actor-task paths."""
+        if spec.num_returns != "streaming" or conn is None:
+            return None
+        from ray_tpu.core.streaming import STREAM_PUSH_CHANNEL
+
+        loop_ = asyncio.get_event_loop()
+
+        def emit(payload):  # runs on the lane thread
+            asyncio.run_coroutine_threadsafe(
+                conn.push(STREAM_PUSH_CHANNEL, payload), loop_
+            ).result(timeout=60)
+
+        return emit
+
     async def handle_push_task(self, spec: TaskSpec, conn=None) -> Dict[str, Any]:
         if spec.kind == TaskKind.ACTOR_TASK:
-            return await self._handle_actor_task(spec)
+            return await self._handle_actor_task(spec, conn)
         logger.debug("executing %s %s", spec.name, spec.task_id.hex()[:8])
-        emit = None
-        if spec.num_returns == "streaming" and conn is not None:
-            # stream items push back over the submission connection,
-            # ordered by TCP (reference: generator returns stream through
-            # the reply channel, _raylet.pyx:1345)
-            from ray_tpu.core.streaming import STREAM_PUSH_CHANNEL
-
-            loop_ = asyncio.get_event_loop()
-
-            def emit(payload):  # runs on the lane thread
-                asyncio.run_coroutine_threadsafe(
-                    conn.push(STREAM_PUSH_CHANNEL, payload), loop_
-                ).result(timeout=60)
+        emit = self._make_emit(spec, conn)
 
         # Normal tasks run on the pooled lane (thread spawn per task costs
         # real throughput). Cancellation safety: cancel_task delivers
@@ -152,7 +160,7 @@ class TaskExecutor:
         logger.debug("finished %s %s", spec.name, spec.task_id.hex()[:8])
         return {"results": results}
 
-    async def _handle_actor_task(self, spec: TaskSpec) -> Dict[str, Any]:
+    async def _handle_actor_task(self, spec: TaskSpec, conn=None) -> Dict[str, Any]:
         # built-in methods
         if spec.method_name == "__ray_ready__":
             return {"results": self._package(spec, [(spec.return_ids[0], True)])}
@@ -182,6 +190,17 @@ class TaskExecutor:
         if method is None:
             err = TaskError(spec.name, AttributeError(f"no method {spec.method_name!r}"))
             return {"results": [(oid.binary(), "error", pickle.dumps(err)) for oid in spec.return_ids]}
+        if spec.num_returns == "streaming" and inspect.iscoroutinefunction(method):
+            err = TaskError(
+                spec.name,
+                TypeError(
+                    "streaming actor method must be a (sync or async) "
+                    "generator, not a coroutine returning a value"
+                ),
+            )
+            from ray_tpu.core.streaming import streaming_error_result
+
+            return {"results": [streaming_error_result(err)]}
         if inspect.iscoroutinefunction(method):
             try:
                 self._apply_runtime_env(spec)  # dedicated worker: permanent
@@ -194,17 +213,18 @@ class TaskExecutor:
                     ]
                 }
             return await self._run_async_method(spec, method)
+        emit = self._make_emit(spec, conn)
         caller = spec.owner.worker_id if spec.owner else b""
         if self._max_concurrency == 1 and not spec.concurrency_group:
             await self._wait_turn(caller, spec.seq_no)
             # submission order into the single-thread lane = execution order
             loop = asyncio.get_event_loop()
-            fut = loop.run_in_executor(self._lane_for(spec), self._execute, spec)
+            fut = loop.run_in_executor(self._lane_for(spec), self._execute, spec, emit)
             self._advance(caller)
             results = await fut
         else:
             loop = asyncio.get_event_loop()
-            results = await loop.run_in_executor(self._lane_for(spec), self._execute, spec)
+            results = await loop.run_in_executor(self._lane_for(spec), self._execute, spec, emit)
         return {"results": results}
 
     async def _wait_turn(self, caller: bytes, seq: int) -> None:
@@ -369,6 +389,8 @@ class TaskExecutor:
     def _execute_inner(self, spec: TaskSpec, emit=None) -> List[Tuple[bytes, str, Any]]:
         self.api_worker.job_id = spec.job_id
         self.api_worker.set_task_context(spec.task_id, spec.job_id)
+        if spec.kind != TaskKind.ACTOR_TASK:  # actors keep creation-time resources
+            self.api_worker.assigned_resources = dict(spec.resources or {})
         tid = spec.task_id.binary()
 
         def error_results(err) -> List[Tuple[bytes, str, Any]]:
@@ -458,7 +480,11 @@ class TaskExecutor:
         count = 0
         try:
             result = fn(*args, **kwargs)
-            if not inspect.isgenerator(result) and not hasattr(result, "__iter__"):
+            if inspect.isasyncgen(result):
+                # async generator driven from this lane thread on a
+                # private loop (reference: async streaming replicas)
+                result = _drain_async_gen(result)
+            elif not inspect.isgenerator(result) and not hasattr(result, "__iter__"):
                 raise TypeError(
                     f"num_returns='streaming' task {spec.name} must return "
                     f"a generator/iterable, got {type(result).__name__}"
@@ -535,6 +561,21 @@ class TaskExecutor:
             kind, payload = self._store_value(oid, value, spec.name)
             out.append((oid.binary(), kind, payload))
         return out
+
+
+def _drain_async_gen(agen):
+    """Sync iterator over an async generator, driven on a private event
+    loop owned by the calling (lane) thread."""
+    loop = asyncio.new_event_loop()
+    try:
+        while True:
+            try:
+                yield loop.run_until_complete(agen.__anext__())
+            except StopAsyncIteration:
+                return
+    finally:
+        loop.run_until_complete(agen.aclose())
+        loop.close()
 
 
 def _exit_now():

@@ -33,6 +33,7 @@ from ray_tpu.serve.controller import (
     CONTROLLER_NAME,
     get_or_create_controller,
 )
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.proxy import start_http, stop_http
 from ray_tpu.serve.router import Router
 
@@ -97,19 +98,49 @@ def deployment(
 
 class DeploymentHandle:
     """Client-side handle: pow-2 routed calls returning ObjectRefs
-    (reference ``DeploymentHandle``/``Router``)."""
+    (reference ``DeploymentHandle``/``Router``).
 
-    def __init__(self, deployment_name: str, controller=None):
+    ``remote()`` is at-most-once and returns an ObjectRef;
+    ``call()`` is retry-until-executed (reference router semantics);
+    ``stream()`` iterates a streaming (generator) deployment's values;
+    ``options(multiplexed_model_id=...)`` routes model-local
+    (reference ``handle.options``)."""
+
+    def __init__(self, deployment_name: str, controller=None, *, _shared_router=None, _model_id: str = ""):
         self._name = deployment_name
         self._controller = controller or get_or_create_controller()
-        self._router = Router(self._controller, deployment_name)
+        self._router = _shared_router or Router(self._controller, deployment_name)
+        self._model_id = _model_id
+
+    def options(self, *, multiplexed_model_id: str = "") -> "DeploymentHandle":
+        # shares the router (and its long-poll thread + stats cache)
+        return DeploymentHandle(
+            self._name,
+            self._controller,
+            _shared_router=self._router,
+            _model_id=multiplexed_model_id or self._model_id,
+        )
 
     def remote(self, *args, **kwargs):
-        return self._router.dispatch("__call__", args, kwargs)
+        return self._router.dispatch("__call__", args, kwargs, self._model_id)
+
+    def call(self, *args, _timeout: Optional[float] = 60.0, **kwargs):
+        """Blocking retry-until-executed call (survives replica death
+        mid-rolling-update)."""
+        return self._router.execute(
+            "__call__", args, kwargs, model_id=self._model_id, timeout=_timeout
+        )
+
+    def stream(self, *args, _method: str = "__call__", _timeout: Optional[float] = 60.0, **kwargs):
+        """Iterate a generator deployment's yielded values (token
+        streaming; reference streaming DeploymentResponseGenerator)."""
+        return self._router.execute_stream(
+            _method, args, kwargs, model_id=self._model_id, timeout=_timeout
+        )
 
     def method(self, method_name: str):
         def call(*args, **kwargs):
-            return self._router.dispatch(method_name, args, kwargs)
+            return self._router.dispatch(method_name, args, kwargs, self._model_id)
 
         return call
 
@@ -172,6 +203,8 @@ __all__ = [
     "delete",
     "deployment",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "run",
     "shutdown",
     "start_http",

@@ -50,12 +50,19 @@ class _DeploymentState:
         self.last_stuck_evict_ts = 0.0
         #: last time a starter died as runtime-unplaceable
         self.unplaceable_ts = 0.0
+        #: replica uid -> multiplexed model ids loaded there (pushed by
+        #: replicas; propagated to routers through the long-poll)
+        self.replica_models: Dict[str, List[str]] = {}
 
 
 class _ServeController:
     """Runs inside an actor; a background thread reconciles."""
 
-    def __init__(self):
+    def __init__(self, registered_namespace=None):
+        # the namespace this controller's NAME lives in (the creating
+        # driver's) — the controller process's own namespace differs, and
+        # replicas need the registered one to get_actor() us for reports
+        self._registered_namespace = registered_namespace
         self._deployments: Dict[str, _DeploymentState] = {}
         self._lock = threading.Lock()
         # serializes whole reconcile passes: deploy() (RPC thread) and the
@@ -121,11 +128,50 @@ class _ServeController:
             state = self._deployments.get(name)
             return [r for _v, r in state.replicas] if state else []
 
+    def _routing_set(self, name: str) -> List[Tuple[Any, List[str]]]:
+        """(handle, loaded_model_ids) pairs — what routers consume."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return []
+            return [
+                (r, state.replica_models.get(r.actor_id.hex(), []))
+                for _v, r in state.replicas
+            ]
+
+    def report_models(self, name: str, replica_uid: str, models: List[str]) -> bool:
+        """Replica-pushed multiplexed-model set (reference: model ids
+        flow replica -> controller -> routers via long-poll broadcast,
+        ``multiplex.py`` + ``long_poll.py``)."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return False
+            state.replica_models[replica_uid] = list(models)
+            # prune entries for replicas no longer tracked — without this
+            # the dict grows one entry per replica generation forever
+            live = {
+                r.actor_id.hex()
+                for group in (
+                    state.replicas,
+                    [(v, h) for v, h, _t in state.starting],
+                    [(v, h) for v, h, _t in state.draining],
+                )
+                for _v, r in group
+            }
+            live.add(replica_uid)
+            for uid in [u for u in state.replica_models if u not in live]:
+                del state.replica_models[uid]
+        self._bump(name)
+        return True
+
     @ray_tpu.method(concurrency_group="longpoll")
     def poll_replicas(self, name: str, known_version: int, timeout_s: float = 30.0):
         """Long-poll (reference ``LongPollClient``): returns
-        ``(version, replicas)`` as soon as the routing set differs from
-        ``known_version`` (or on timeout, with the current state)."""
+        ``(version, routing_set)`` as soon as the routing set differs
+        from ``known_version`` (or on timeout, with the current state).
+        The routing set pairs each replica handle with its loaded
+        multiplexed-model ids."""
         deadline = time.monotonic() + timeout_s
         with self._change:
             while self._versions.get(name, 0) == known_version:
@@ -134,7 +180,7 @@ class _ServeController:
                     break
                 self._change.wait(min(remaining, 1.0))
             version = self._versions.get(name, 0)
-        return version, self.get_replicas(name)
+        return version, self._routing_set(name)
 
     def routes(self) -> Dict[str, str]:
         """route_prefix -> deployment name (proxy routing table)."""
@@ -152,7 +198,13 @@ class _ServeController:
                     "target": st.target,
                     "replicas": len(st.replicas),
                     "starting": len(st.starting),
+                    "draining": len(st.draining),
                     "version": st.version,
+                    # rolling-update progress: the roll is done when every
+                    # routed replica is on the current version
+                    "replicas_current_version": sum(
+                        1 for v, _r in st.replicas if v == st.version
+                    ),
                     "autoscaling": st.config.autoscaling is not None,
                 }
                 for name, st in self._deployments.items()
@@ -196,7 +248,8 @@ class _ServeController:
         opts = dict(st.config.ray_actor_options)
         opts.setdefault("max_concurrency", st.config.max_concurrent_queries)
         return Replica.options(**opts).remote(
-            st.cls_or_fn, st.init_args, st.init_kwargs
+            st.cls_or_fn, st.init_args, st.init_kwargs, st.name,
+            self._registered_namespace,
         )
 
     def _core_actor_info(self, handle) -> Optional[Dict[str, Any]]:
@@ -426,10 +479,14 @@ def get_or_create_controller():
     # long-polls park a thread each for up to 30s; a dedicated
     # concurrency group keeps any number of routers from starving
     # deploy/status/get_replicas lanes
+    try:
+        ns = ray_tpu.get_runtime_context().namespace
+    except Exception:
+        ns = None
     return ServeController.options(
         name=CONTROLLER_NAME,
         num_cpus=0,
         max_concurrency=16,
         concurrency_groups={"longpoll": 32},
         get_if_exists=True,
-    ).remote()
+    ).remote(ns)

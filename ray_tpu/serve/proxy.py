@@ -17,6 +17,7 @@ from ray_tpu.serve.router import Router
 
 _proxy = None
 _lock = threading.Lock()
+_SENTINEL = object()
 
 
 class HttpProxy:
@@ -62,6 +63,8 @@ class HttpProxy:
                 break
         if target is None:
             return web.json_response({"error": f"no route for {path}"}, status=404)
+        model_id = request.headers.get("serve-multiplexed-model-id", "")
+        streaming = "text/event-stream" in request.headers.get("Accept", "")
         try:
             body: Any = None
             if request.can_read_body:
@@ -73,17 +76,68 @@ class HttpProxy:
                         body = raw.decode()
             router = self._router_for(target)
             loop = asyncio.get_event_loop()
-            ref = await loop.run_in_executor(
-                None, lambda: router.dispatch("__call__", (body,), {})
-            )
+            if streaming:
+                return await self._handle_stream(
+                    request, router, body, model_id
+                )
+            # retry-until-executed: replica death mid-rolling-update must
+            # not surface to the HTTP client (reference router semantics)
             result = await loop.run_in_executor(
-                None, lambda: ray_tpu.get(ref, timeout=60)
+                None,
+                lambda: router.execute(
+                    "__call__", (body,), {}, model_id=model_id, timeout=60
+                ),
             )
             if isinstance(result, Exception):
                 raise result
             return web.json_response({"result": result})
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": repr(e)}, status=500)
+
+    async def _handle_stream(self, request, router, body, model_id):
+        """SSE: each yielded item becomes one ``data:`` event (reference
+        gRPC/HTTP streaming proxy responses, proxy.py:536). Once the
+        response is prepared this method ALWAYS returns it — a client
+        disconnect mid-stream must not bubble to the outer handler
+        (which would try to send a second response) and must close the
+        value generator so the replica stops producing."""
+        from aiohttp import web
+
+        loop = asyncio.get_event_loop()
+        values = await loop.run_in_executor(
+            None,
+            lambda: router.execute_stream(
+                "__call__", (body,), {}, model_id=model_id, timeout=60
+            ),
+        )
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+        it = iter(values)
+        try:
+            while True:
+                try:
+                    item = await loop.run_in_executor(None, next, it, _SENTINEL)
+                except Exception as e:  # noqa: BLE001 — mid-stream failure
+                    await resp.write(
+                        f"event: error\ndata: {json.dumps(repr(e))}\n\n".encode()
+                    )
+                    break
+                if item is _SENTINEL:
+                    break
+                await resp.write(f"data: {json.dumps(item)}\n\n".encode())
+            await resp.write_eof()
+        except (ConnectionError, asyncio.CancelledError):
+            pass  # client went away mid-stream
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                await loop.run_in_executor(None, close)
+        return resp
 
     def _serve(self) -> None:
         from aiohttp import web

@@ -7,9 +7,16 @@ sample two replicas, compare queue lengths, send to the shorter — fed by
 the routing set changes), not a periodic poll. Deploys/scale-ups/
 replica deaths propagate to routers in milliseconds.
 
-Routing is at-most-once: a dispatch racing a replica death surfaces
-ActorDiedError on the returned ref (callers retry); the next push drops
-the dead replica from the candidate set."""
+Execution semantics (reference ``router.py``): ``execute``/
+``execute_stream`` are retry-until-executed — a dispatch that races a
+replica death re-chooses among the survivors instead of surfacing
+ActorDiedError to the caller (what keeps rolling updates zero-drop).
+The raw ``dispatch`` remains at-most-once for callers that manage
+their own refs.
+
+Model multiplexing: a request carrying ``model_id`` prefers replicas
+whose cached stats report that model loaded (reference model-aware
+replica scheduling), falling back to pow-2 over all replicas."""
 
 from __future__ import annotations
 
@@ -17,9 +24,10 @@ import random
 import threading
 import time
 import weakref
-from typing import Any, List
+from typing import Any, List, Optional
 
 import ray_tpu
+from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
 
 _STATS_TTL_S = 0.25
 
@@ -36,14 +44,14 @@ def _poll_loop(router_ref: "weakref.ref", controller, deployment: str) -> None:
             return
         del r
         try:
-            version, replicas = ray_tpu.get(
+            version, routing_set = ray_tpu.get(
                 controller.poll_replicas.remote(deployment, version, 30.0),
                 timeout=45,
             )
             r = router_ref()
             if r is None or r._closed:
                 return
-            r._apply(replicas)
+            r._apply(routing_set)
             del r
         except Exception:
             # controller briefly unavailable: back off, keep serving
@@ -62,6 +70,8 @@ class Router:
         # fresh stats RPCs per dispatch would double request latency and
         # add 2x load (the reference compares CACHED queue lengths)
         self._stats: dict = {}
+        # replica actor_id -> loaded model ids (controller-pushed)
+        self._models: dict = {}
         self._poller_started = False
         self._poller_lock = threading.Lock()
         self._closed = False
@@ -85,25 +95,55 @@ class Router:
                 name=f"serve-router-{self._deployment}",
             ).start()
 
-    def _apply(self, replicas: List[Any]) -> None:
+    def _apply(self, routing_set: List[Any]) -> None:
+        """routing_set: [(handle, loaded_model_ids)] pairs from the
+        controller's long-poll (model ids drive model-local routing)."""
+        replicas, models = [], {}
+        for entry in routing_set:
+            handle, mids = entry
+            replicas.append(handle)
+            models[handle.actor_id] = tuple(mids)
         with self._replicas_lock:
             self._replicas = replicas
-            live = {r.actor_id for r in replicas}
+            self._models = models
+            live = set(models)
             self._stats = {k: v for k, v in self._stats.items() if k in live}
         if replicas:
             self._have_replicas.set()
         else:
             self._have_replicas.clear()
 
+    def _drop_replica(self, replica) -> None:
+        """Locally remove a replica observed dead — the controller push
+        will confirm shortly, but requests in THIS window must not keep
+        choosing the corpse."""
+        with self._replicas_lock:
+            self._replicas = [
+                r for r in self._replicas if r.actor_id != replica.actor_id
+            ]
+            self._stats.pop(replica.actor_id, None)
+            self._models.pop(replica.actor_id, None)
+            if not self._replicas:
+                self._have_replicas.clear()
+
     # -- choice ----------------------------------------------------------
-    def choose_replica(self):
+    def choose_replica(self, model_id: str = ""):
         self._ensure_poller()
         if not self._have_replicas.wait(timeout=30):
             raise RuntimeError(f"no replicas for deployment {self._deployment!r}")
         with self._replicas_lock:
             replicas = list(self._replicas)
         if not replicas:
-            return self.choose_replica()  # raced a scale-to-zero push
+            return self.choose_replica(model_id)  # raced a scale-to-zero push
+        if model_id:
+            # model-aware: prefer replicas the controller says already
+            # hold the model (replica-pushed, so no stats-TTL staleness)
+            with_model = [
+                r for r in replicas
+                if model_id in self._models.get(r.actor_id, ())
+            ]
+            if with_model:
+                replicas = with_model
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
@@ -125,12 +165,105 @@ class Router:
         self._stats[key] = (now, ongoing)
         return ongoing
 
-    def dispatch(self, method: str, args, kwargs):
-        replica = self.choose_replica()
+    def _bump(self, replica) -> None:
         # optimistic local bump so a burst within the TTL window spreads
         # instead of dogpiling the momentarily-shortest queue
-        key = replica.actor_id
-        entry = self._stats.get(key)
+        entry = self._stats.get(replica.actor_id)
         if entry is not None:
-            self._stats[key] = (entry[0], entry[1] + 1.0)
-        return replica.handle_request.remote(method, list(args), dict(kwargs or {}))
+            self._stats[replica.actor_id] = (entry[0], entry[1] + 1.0)
+
+    # -- dispatch ---------------------------------------------------------
+    def dispatch(self, method: str, args, kwargs, model_id: str = ""):
+        """At-most-once: returns the replica call's ObjectRef."""
+        replica = self.choose_replica(model_id)
+        self._bump(replica)
+        return replica.handle_request.remote(
+            method, list(args), dict(kwargs or {}), model_id
+        )
+
+    def dispatch_stream(self, method: str, args, kwargs, model_id: str = ""):
+        """Streaming call: returns the replica generator's ref iterator."""
+        replica = self.choose_replica(model_id)
+        self._bump(replica)
+        return replica.handle_request_streaming.options(
+            num_returns="streaming"
+        ).remote(method, list(args), dict(kwargs or {}), model_id)
+
+    def execute(
+        self,
+        method: str,
+        args,
+        kwargs,
+        *,
+        model_id: str = "",
+        timeout: Optional[float] = 60.0,
+    ):
+        """Retry-until-executed (reference router semantics): a dispatch
+        that lands on a dying replica re-chooses. App-level exceptions
+        are NOT retried — only replica death/crash."""
+        deadline = time.monotonic() + (timeout if timeout is not None else 3600)
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            replica = self.choose_replica(model_id)
+            self._bump(replica)
+            ref = replica.handle_request.remote(
+                method, list(args), dict(kwargs or {}), model_id
+            )
+            try:
+                remaining = max(1.0, deadline - time.monotonic())
+                return ray_tpu.get(ref, timeout=remaining)
+            except (ActorDiedError, WorkerCrashedError) as e:
+                last_err = e
+                self._drop_replica(replica)
+                continue
+        raise last_err or TimeoutError(
+            f"no replica executed {self._deployment}.{method} in time"
+        )
+
+    def execute_stream(
+        self,
+        method: str,
+        args,
+        kwargs,
+        *,
+        model_id: str = "",
+        timeout: Optional[float] = 60.0,
+    ):
+        """Streaming with dispatch retry: re-chooses if the stream dies
+        BEFORE the first item (nothing was delivered, safe to replay);
+        mid-stream death propagates — replaying would duplicate items."""
+        deadline = time.monotonic() + (timeout if timeout is not None else 3600)
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            replica = self.choose_replica(model_id)
+            self._bump(replica)
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(method, list(args), dict(kwargs or {}), model_id)
+            try:
+                # bounded time-to-first-item: a replica stuck before its
+                # first yield must not park this request forever
+                first_ref = gen.next_with_timeout(
+                    max(1.0, deadline - time.monotonic())
+                )
+                first = ray_tpu.get(first_ref, timeout=max(1.0, deadline - time.monotonic()))
+            except StopIteration:
+                def _empty():
+                    return
+                    yield  # pragma: no cover
+                return _empty()
+            except (ActorDiedError, WorkerCrashedError) as e:
+                last_err = e
+                self._drop_replica(replica)
+                continue
+            it = iter(gen)
+
+            def _rest(first=first, it=it):
+                yield first
+                for ref in it:
+                    yield ray_tpu.get(ref, timeout=60)
+
+            return _rest()
+        raise last_err or TimeoutError(
+            f"no replica started stream {self._deployment}.{method} in time"
+        )

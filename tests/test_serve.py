@@ -235,7 +235,9 @@ def test_rolling_update_zero_downtime(cluster):
     def hammer():
         while not stop.is_set():
             try:
-                results.append(ray_tpu.get(handle.remote(0), timeout=30))
+                # retry-until-executed: the router re-chooses on a
+                # death-raced dispatch, so the roll drops ZERO requests
+                results.append(handle.call(0, _timeout=30))
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
             time.sleep(0.02)
@@ -246,16 +248,28 @@ def test_rolling_update_zero_downtime(cluster):
         serve.run(
             Versioned.options(version="v2").bind("v2"), name="versioned"
         )
+        # wait for the ROLL to finish (every routed replica on v2, none
+        # starting/draining) — breaking on the first 'v2' response races
+        # a legitimately-mixed routing set mid-roll (advisor finding r4)
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
-            if results and results[-1] == "v2":
+            st = serve.status().get("Versioned", {})
+            if (
+                st.get("version") == "v2"
+                and st.get("replicas_current_version") == st.get("replicas")
+                and st.get("replicas", 0) >= 2
+                and st.get("starting", 0) == 0
+                and st.get("draining", 0) == 0
+            ):
                 break
             time.sleep(0.2)
+        # a few post-roll requests must all answer v2
+        post_roll = [handle.call(0, _timeout=30) for _ in range(3)]
     finally:
         stop.set()
         t.join(timeout=30)
     assert not errors, errors[:3]
-    assert results[-1] == "v2", results[-5:]
+    assert post_roll == ["v2"] * 3, post_roll
     assert "v1" in results  # the stream spanned the roll
     serve.delete("Versioned")
 
@@ -281,3 +295,155 @@ def test_same_version_redeploy_keeps_replicas(cluster):
     pid2 = ray_tpu.get(handle.remote(0), timeout=60)
     assert pid1 == pid2
     serve.delete("Stable")
+
+
+def test_streaming_deployment_handle(cluster):
+    """Generator deployments stream values through handle.stream()
+    (reference streaming replica responses)."""
+
+    @serve.deployment(num_replicas=1, ray_actor_options={"num_cpus": 0.25})
+    class Tokens:
+        def __call__(self, prompt):
+            for i, word in enumerate(str(prompt).split()):
+                yield {"index": i, "token": word}
+
+    handle = serve.run(Tokens.bind(), name="tokens")
+    out = list(handle.stream("the quick brown fox"))
+    assert [o["token"] for o in out] == ["the", "quick", "brown", "fox"]
+    assert [o["index"] for o in out] == [0, 1, 2, 3]
+    serve.delete("Tokens")
+
+
+def test_streaming_async_deployment(cluster):
+    @serve.deployment(num_replicas=1, ray_actor_options={"num_cpus": 0.25})
+    class AsyncTokens:
+        async def __call__(self, n):
+            import asyncio as aio
+
+            for i in range(n):
+                await aio.sleep(0.01)
+                yield f"t{i}"
+
+    handle = serve.run(AsyncTokens.bind(), name="atokens")
+    assert list(handle.stream(3)) == ["t0", "t1", "t2"]
+    serve.delete("AsyncTokens")
+
+
+def test_streaming_http_sse(cluster):
+    """SSE through the HTTP proxy: Accept: text/event-stream gets one
+    data: event per yielded item (reference proxy streaming)."""
+
+    @serve.deployment(num_replicas=1, route_prefix="/sse", ray_actor_options={"num_cpus": 0.25})
+    class SSE:
+        def __call__(self, body):
+            for i in range(3):
+                yield {"n": i}
+
+    serve.run(SSE.bind(), name="sse")
+    from ray_tpu.serve.controller import get_or_create_controller
+
+    # start_http is a per-process singleton: reuse whatever port it holds
+    proxy = serve.start_http(get_or_create_controller(), port=18457)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{proxy.port}/sse",
+        data=b"{}",
+        headers={"Accept": "text/event-stream", "Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        raw = resp.read().decode()
+    events = [
+        json.loads(line[len("data: "):])
+        for line in raw.splitlines()
+        if line.startswith("data: ")
+    ]
+    assert events == [{"n": 0}, {"n": 1}, {"n": 2}], raw
+    serve.delete("SSE")
+
+
+def test_multiplexed_models_lru_eviction(cluster):
+    """@serve.multiplexed: per-replica LRU of loaded models with
+    eviction beyond max_num_models_per_replica (reference
+    multiplex.py:22), model id carried by handle.options()."""
+
+    @serve.deployment(num_replicas=1, ray_actor_options={"num_cpus": 0.25})
+    class Multi:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": len(self.loads)}
+
+        async def __call__(self, x):
+            model = await self.get_model(serve.get_multiplexed_model_id())
+            return {"model": model["id"], "x": x}
+
+        def load_history(self):
+            return self.loads
+
+    handle = serve.run(Multi.bind(), name="multi")
+    # three models through a capacity-2 cache
+    for mid in ["m1", "m2", "m1", "m3", "m1"]:
+        out = ray_tpu.get(
+            handle.options(multiplexed_model_id=mid).remote(1), timeout=60
+        )
+        assert out["model"] == mid
+    history = ray_tpu.get(handle.method("load_history")(), timeout=30)
+    # m1: loaded once then cache-hit (still resident when m3 evicted m2)
+    assert history == ["m1", "m2", "m3"], history
+    # m2 was evicted; calling it again re-loads
+    ray_tpu.get(handle.options(multiplexed_model_id="m2").remote(1), timeout=60)
+    history = ray_tpu.get(handle.method("load_history")(), timeout=30)
+    assert history == ["m1", "m2", "m3", "m2"], history
+    serve.delete("Multi")
+
+
+def test_multiplexed_model_aware_routing(cluster):
+    """With multiple replicas, requests for a model prefer the replica
+    that already loaded it (model-locality routing)."""
+    import os
+
+    @serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 0.25})
+    class Which:
+        @serve.multiplexed(max_num_models_per_replica=4)
+        async def get_model(self, model_id: str):
+            return model_id
+
+        async def __call__(self, x):
+            await self.get_model(serve.get_multiplexed_model_id())
+            return os.getpid()
+
+    handle = serve.run(Which.bind(), name="which")
+    h = handle.options(multiplexed_model_id="modelA")
+    first = h.call(0, _timeout=60)
+    # subsequent calls for the same model land on the same replica
+    # (stats TTL is 250ms — wait for a fresh stats fetch to pick up the
+    # loaded-models set)
+    time.sleep(0.4)
+    pids = {h.call(0, _timeout=60) for _ in range(8)}
+    assert pids == {first}, (first, pids)
+    serve.delete("Which")
+
+
+def test_dispatch_retry_on_replica_death(cluster):
+    """handle.call() re-chooses when its dispatch races a replica kill
+    (retry-until-executed; reference router)."""
+
+    @serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 0.25})
+    class Sturdy:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Sturdy.bind(), name="sturdy")
+    assert handle.call(4, _timeout=60) == 8
+    # kill one replica out from under the router's cached set
+    replicas = ray_tpu.get(
+        handle._controller.get_replicas.remote("Sturdy"), timeout=30
+    )
+    ray_tpu.kill(replicas[0])
+    # every call still succeeds (some will race the corpse and retry)
+    for i in range(10):
+        assert handle.call(i, _timeout=60) == i * 2
+    serve.delete("Sturdy")

@@ -131,15 +131,62 @@ def test_streaming_failed_dependency_raises(cluster):
         next(it)
 
 
-def test_streaming_actor_method_rejected(cluster):
+def test_streaming_actor_method(cluster):
+    """Actor generator methods stream items exactly like normal tasks
+    (reference: streaming generators on actors back Serve's token
+    streaming, _raylet.pyx:1345)."""
+    @ray_tpu.remote
+    class A:
+        def __init__(self):
+            self.calls = 0
+
+        def gen(self, n):
+            self.calls += 1
+            for i in range(n):
+                yield i * 10
+
+        def total(self):
+            return self.calls
+
+    a = A.remote()
+    it = a.gen.options(num_returns="streaming").remote(4)
+    vals = [ray_tpu.get(ref, timeout=30) for ref in it]
+    assert vals == [0, 10, 20, 30]
+    assert ray_tpu.get(a.total.remote(), timeout=30) == 1
+    # second stream on the same (stateful) actor
+    it2 = a.gen.options(num_returns="streaming").remote(2)
+    assert [ray_tpu.get(r, timeout=30) for r in it2] == [0, 10]
+
+
+def test_streaming_async_actor_generator(cluster):
+    """Async-generator methods on concurrent actors stream too (the
+    Serve replica shape)."""
+    @ray_tpu.remote(max_concurrency=4)
+    class A:
+        async def agen(self, n):
+            import asyncio as aio
+
+            for i in range(n):
+                await aio.sleep(0.01)
+                yield f"tok{i}"
+
+    a = A.remote()
+    it = a.agen.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r, timeout=30) for r in it] == ["tok0", "tok1", "tok2"]
+
+
+def test_streaming_actor_mid_stream_error(cluster):
     @ray_tpu.remote
     class A:
         def gen(self):
             yield 1
+            raise RuntimeError("boom mid-stream")
 
     a = A.remote()
-    with pytest.raises(ValueError, match="streaming"):
-        a.gen.options(num_returns="streaming").remote()
+    it = iter(a.gen.options(num_returns="streaming").remote())
+    assert ray_tpu.get(next(it), timeout=30) == 1
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(next(it), timeout=30)
 
 
 def test_producer_backpressure_bounds_owner_buffer(cluster):
