@@ -16,13 +16,23 @@ from ray_tpu.data.grouped import (
     Std,
     Sum,
 )
+from ray_tpu.data.datasink import (
+    CSVSink,
+    Datasink,
+    JSONSink,
+    NumpySink,
+    ParquetSink,
+)
 from ray_tpu.data.read_api import (
+    Datasource,
     from_arrow,
     from_items,
     from_numpy,
     from_pandas,
     range_,
     read_csv,
+    read_datasource,
+    read_json,
     read_numpy,
     read_parquet,
 )
@@ -42,8 +52,14 @@ __all__ = [
     "Std",
     "Sum",
     "VALUE_COL",
+    "CSVSink",
+    "Datasink",
+    "Datasource",
     "Dataset",
     "DataShard",
+    "JSONSink",
+    "NumpySink",
+    "ParquetSink",
     "from_arrow",
     "from_items",
     "from_numpy",
@@ -51,6 +67,8 @@ __all__ = [
     "range",
     "range_",
     "read_csv",
+    "read_datasource",
+    "read_json",
     "read_numpy",
     "read_parquet",
 ]

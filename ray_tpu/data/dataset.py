@@ -153,22 +153,25 @@ class Dataset:
         return self
 
     # -- global ops (require materialization) ----------------------------
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Global shuffle: materialize, concat, permute, re-block.
-        Reference: all-to-all exchange (``planner/exchange``); single-pass
-        materialized shuffle is the honest small-scale equivalent."""
+    def random_shuffle(
+        self, *, seed: Optional[int] = None, num_blocks: Optional[int] = None
+    ) -> "Dataset":
+        """Global shuffle as a DISTRIBUTED map/reduce exchange
+        (``data/shuffle.py``; reference push-based shuffle,
+        ``push_based_shuffle_task_scheduler.py:590``): rows scatter to
+        random output partitions in map tasks, reducers merge + permute.
+        The driver touches refs only — the data plane stays in the
+        object store (spilling under pressure), so a store-oversized
+        dataset shuffles without driver materialization."""
+        from ray_tpu.data.shuffle import shuffle_exchange
+
         refs = self._block_refs()
-        blocks = [ray_tpu.get(r, timeout=600) for r in refs]
-        if not blocks:
+        if not refs:
             return self
-        merged = block_concat(blocks)
-        n = block_num_rows(merged)
-        rng = np.random.default_rng(seed)
-        perm = rng.permutation(n)
-        shuffled = block_take(merged, perm)
-        per = max(1, n // max(1, len(blocks)))
-        out_blocks = [block_slice(shuffled, s, min(n, s + per)) for s in range(0, n, per)]
-        return _from_blocks(out_blocks)
+        out = shuffle_exchange(refs, num_output_blocks=num_blocks, seed=seed)
+        ds = Dataset(out)
+        ds._materialized = list(out)  # reducer outputs ARE the blocks
+        return ds
 
     def repartition(self, num_blocks: int) -> "Dataset":
         refs = self._block_refs()
@@ -331,6 +334,34 @@ class Dataset:
             ]
         sources, stages = self._plan()
         return [DataShard(sources[i::n], stages, i, n) for i in range(n)]
+
+    # -- write path ------------------------------------------------------
+    def write_datasink(self, sink) -> List[Any]:
+        """Write via a ``Datasink`` (reference ``datasink.py:51``): one
+        remote task per block; driver handles lifecycle hooks only."""
+        from ray_tpu.data.datasink import write_datasink
+
+        return write_datasink(self, sink)
+
+    def write_parquet(self, path: str) -> List[str]:
+        from ray_tpu.data.datasink import ParquetSink
+
+        return self.write_datasink(ParquetSink(path))
+
+    def write_csv(self, path: str) -> List[str]:
+        from ray_tpu.data.datasink import CSVSink
+
+        return self.write_datasink(CSVSink(path))
+
+    def write_json(self, path: str) -> List[str]:
+        from ray_tpu.data.datasink import JSONSink
+
+        return self.write_datasink(JSONSink(path))
+
+    def write_numpy(self, path: str) -> List[str]:
+        from ray_tpu.data.datasink import NumpySink
+
+        return self.write_datasink(NumpySink(path))
 
     def __repr__(self) -> str:
         return (

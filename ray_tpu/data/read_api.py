@@ -106,9 +106,63 @@ def read_csv(paths: Union[str, Sequence[str]]) -> Dataset:
 
 
 def read_numpy(paths: Union[str, Sequence[str]]) -> Dataset:
-    files = _expand_paths(paths, ".npy")
+    """Reads ``.npy`` (one array → the value column) and ``.npz``
+    (NumpySink output: one entry per block column)."""
+    try:
+        files = _expand_paths(paths, ".npy")
+    except FileNotFoundError:
+        files = _expand_paths(paths, ".npz")
 
     def make_read(path: str):
-        return lambda: {VALUE_COL: np.load(path)}
+        def read():
+            loaded = np.load(path)
+            if isinstance(loaded, np.lib.npyio.NpzFile):
+                return {k: loaded[k] for k in loaded.files}
+            return {VALUE_COL: loaded}
+
+        return read
 
     return Dataset([make_read(f) for f in files])
+
+
+def read_json(paths: Union[str, Sequence[str]]) -> Dataset:
+    """JSON-lines files, one read task per file (reference json
+    datasource). Rows may be objects (become columns) or scalars
+    (become the value column)."""
+    files = _expand_paths(paths, ".json")
+
+    def make_read(path: str):
+        def read():
+            import json
+
+            rows = []
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+            blocks = blocks_from_rows(rows, max(1, len(rows)))
+            return blocks[0] if blocks else {VALUE_COL: np.asarray([])}
+
+        return read
+
+    return Dataset([make_read(f) for f in files])
+
+
+class Datasource:
+    """Custom-source ABC (reference
+    ``data/datasource/datasource.py``): implement ``get_read_tasks(n)``
+    returning no-arg callables, each producing one block."""
+
+    def get_read_tasks(self, parallelism: int):
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+
+def read_datasource(source: Datasource, *, parallelism: int = 8) -> Dataset:
+    tasks = list(source.get_read_tasks(parallelism))
+    if not tasks:
+        raise ValueError("datasource produced no read tasks")
+    return Dataset(tasks)

@@ -278,3 +278,116 @@ def test_groupby_multiblock_string_keys(cluster):
     ds = rd.from_items([{"w": w} for w in words]).repartition(6)
     out = {r["w"]: int(r["count()"]) for r in ds.groupby("w").count().take_all()}
     assert out == {"alpha": 28, "beta": 20, "gamma": 12}
+
+
+def test_shuffle_is_distributed_exchange(cluster):
+    """random_shuffle must not concatenate the dataset on the driver:
+    the result is produced by reduce tasks (refs), deterministic under a
+    seed, and a real permutation."""
+    ds = rd.range(512).random_shuffle(seed=3)
+    vals = [int(v) for b in ds.iter_batches(batch_size=None) for v in b["value"]]
+    assert sorted(vals) == list(range(512))
+    assert vals != list(range(512))  # actually shuffled
+    # deterministic for a fixed seed + block structure
+    vals2 = [
+        int(v)
+        for b in rd.range(512).random_shuffle(seed=3).iter_batches(batch_size=None)
+        for v in b["value"]
+    ]
+    assert vals == vals2
+
+
+def test_write_parquet_roundtrip(cluster, tmp_path):
+    out = str(tmp_path / "pq")
+    ds = rd.range(100).map(lambda x: {"a": int(x), "b": float(x) * 0.5})
+    files = ds.write_parquet(out)
+    assert files and all(f.endswith(".parquet") for f in files)
+    back = rd.read_parquet(out)
+    rows = sorted(
+        (int(b["a"][i]), float(b["b"][i]))
+        for b in back.iter_batches(batch_size=None)
+        for i in range(len(b["a"]))
+    )
+    assert rows == [(i, i * 0.5) for i in range(100)]
+
+
+def test_write_csv_roundtrip(cluster, tmp_path):
+    out = str(tmp_path / "csv")
+    ds = rd.from_items([{"x": i, "y": i * 2} for i in range(20)])
+    files = ds.write_csv(out)
+    assert files
+    back = rd.read_csv(out)
+    rows = sorted(
+        (int(b["x"][i]), int(b["y"][i]))
+        for b in back.iter_batches(batch_size=None)
+        for i in range(len(b["x"]))
+    )
+    assert rows == [(i, 2 * i) for i in range(20)]
+
+
+def test_write_json_roundtrip(cluster, tmp_path):
+    out = str(tmp_path / "json")
+    ds = rd.from_items([{"k": i} for i in range(10)])
+    ds.write_json(out)
+    back = rd.read_json(out)
+    vals = sorted(
+        int(b["k"][i])
+        for b in back.iter_batches(batch_size=None)
+        for i in range(len(b["k"]))
+    )
+    assert vals == list(range(10))
+
+
+def test_custom_datasink_lifecycle(cluster, tmp_path):
+    """Datasink hooks run driver-side around per-block write tasks
+    (reference datasink.py:51)."""
+    marker = tmp_path / "started"
+
+    class CollectSink(rd.Datasink):
+        def __init__(self, base):
+            self.base = str(base)
+
+        def on_write_start(self):
+            import pathlib
+
+            pathlib.Path(self.base).mkdir(exist_ok=True)
+            (pathlib.Path(self.base) / "started").touch()
+
+        def write(self, block, ctx):
+            return int(sum(int(v) for v in block["value"]))
+
+        def on_write_complete(self, results):
+            self.total = sum(results)
+
+    sink = CollectSink(tmp_path / "sink")
+    rd.range(64).write_datasink(sink)
+    assert (tmp_path / "sink" / "started").exists()
+    assert sink.total == sum(range(64))
+
+
+def test_custom_datasource(cluster):
+    class Squares(rd.Datasource):
+        def get_read_tasks(self, parallelism):
+            def make(i):
+                return lambda: {"sq": np.arange(i * 10, (i + 1) * 10) ** 2}
+            return [make(i) for i in range(4)]
+
+    ds = rd.read_datasource(Squares())
+    vals = sorted(
+        int(v) for b in ds.iter_batches(batch_size=None) for v in b["sq"]
+    )
+    assert vals == sorted(int(i) ** 2 for i in range(40))
+
+
+def test_write_numpy_roundtrip(cluster, tmp_path):
+    out = str(tmp_path / "np")
+    ds = rd.from_items([{"a": i, "b": i * 3} for i in range(30)])
+    files = ds.write_numpy(out)
+    assert files
+    back = rd.read_numpy(out)
+    rows = sorted(
+        (int(b["a"][i]), int(b["b"][i]))
+        for b in back.iter_batches(batch_size=None)
+        for i in range(len(b["a"]))
+    )
+    assert rows == [(i, 3 * i) for i in range(30)]

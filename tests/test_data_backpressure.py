@@ -35,3 +35,36 @@ def test_oversized_dataset_streams_through_small_store(cluster):
     )
     seen = sorted(int(b["value"][0]) for b in ds.iter_batches(batch_size=None))
     assert seen == list(range(n_blocks))
+
+
+def test_oversized_shuffle_streams_through_small_store(cluster):
+    """The distributed shuffle exchange moves a store-oversized dataset
+    entirely through tasks + the object store (driver holds refs only);
+    spilling absorbs the partition working set (reference push-based
+    shuffle, exchange scheduler)."""
+    block_mb = 3
+    n_blocks = 20  # ~60 MB through a 32 MB store
+
+    def make_reader(i):
+        def read():
+            rows = (block_mb << 20) // 16
+            return {
+                "key": np.full(rows, i, dtype=np.int64),
+                "payload": np.arange(rows, dtype=np.int64),
+            }
+        return read
+
+    from ray_tpu.data.dataset import Dataset
+
+    ds = Dataset([make_reader(i) for i in range(n_blocks)])
+    shuffled = ds.random_shuffle(seed=7)
+    # every input row survives the exchange exactly once
+    total = 0
+    key_counts = {}
+    for b in shuffled.iter_batches(batch_size=None):
+        total += len(b["key"])
+        for k, c in zip(*np.unique(b["key"], return_counts=True)):
+            key_counts[int(k)] = key_counts.get(int(k), 0) + int(c)
+    rows_per_block = (block_mb << 20) // 16
+    assert total == n_blocks * rows_per_block
+    assert key_counts == {i: rows_per_block for i in range(n_blocks)}
