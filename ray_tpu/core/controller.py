@@ -638,7 +638,8 @@ class Controller:
         info = self.actors.get(actor_id)
         if info is None or info.state == "DEAD":
             return
-        if info.num_restarts < info.spec.max_restarts and not self._stopping:
+        infinite = info.spec.max_restarts < 0  # -1 = restart forever
+        if (infinite or info.num_restarts < info.spec.max_restarts) and not self._stopping:
             info.num_restarts += 1
             info.state = "RESTARTING"
             info.address = None

@@ -52,6 +52,15 @@ class RemoteError(RpcError):
     """Handler raised on the server; message carries the repr."""
 
 
+class ChaosInjectedError(ConnectionLost):
+    """Injected fault (``testing_rpc_failure``). A ConnectionLost
+    subclass so every retry path treats it as a transient transport
+    failure — the reference rpc_chaos contract: injected failures are
+    RETRIED by the retrying client (they fire BEFORE the handler runs,
+    so a retry never double-executes), exercising retry handling rather
+    than fabricating app-level errors."""
+
+
 def _chaos_should_fail(method: str) -> bool:
     """Fault injection (reference ``RAY_testing_rpc_failure``)."""
     spec = GLOBAL_CONFIG.testing_rpc_failure
@@ -132,7 +141,9 @@ class RpcServer:
             if handler is None:
                 raise RpcError(f"no handler for {method.decode()!r}")
             if _chaos_should_fail(method.decode()):
-                raise RpcError(f"chaos: injected failure for {method.decode()}")
+                raise ChaosInjectedError(
+                    f"chaos: injected failure for {method.decode()}"
+                )
             arg = pickle.loads(payload) if payload else None
             result = await handler(arg, conn)
             await conn.send(REPLY_OK, seq, method, pickle.dumps(result, protocol=5))

@@ -1,0 +1,155 @@
+"""Chaos-injection harness for tests: random worker / node killers.
+
+Reference: ``python/ray/_private/test_utils.py:1496-1740``
+(``ResourceKillerActor`` / ``WorkerKillerActor`` / ``NodeKillerBase`` +
+``start_resource_killer``): background killers take out workers or
+whole nodes at random intervals while a workload runs; the workload
+must still complete CORRECTLY (retries, actor restarts, lineage
+reconstruction). This is the test class the reference's fault-tolerance
+reputation rests on.
+
+Worker identification: workers run ``-m ray_tpu.core.worker_main`` with
+``RAY_TPU_CONTROLLER_ADDR`` in their env — scanning ``/proc`` for that
+pair scopes kills to ONE test cluster even with several running.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import List, Optional
+
+
+def find_worker_pids(controller_addr: str) -> List[int]:
+    """PIDs of worker_main processes attached to ``controller_addr``."""
+    me = os.getpid()
+    out: List[int] = []
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit():
+            continue
+        pid = int(pid_s)
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace")
+            if "ray_tpu.core.worker_main" not in cmd:
+                continue
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env = f.read().decode(errors="replace")
+            if f"RAY_TPU_CONTROLLER_ADDR={controller_addr}" in env:
+                out.append(pid)
+        except (OSError, PermissionError):
+            continue  # raced process exit
+    return out
+
+
+class WorkerKiller:
+    """Kills a random session worker every ``interval_s`` until stopped
+    (reference ``WorkerKillerActor``). Run alongside a workload; the
+    workload's correctness under SIGKILLed workers is the assertion."""
+
+    def __init__(
+        self,
+        controller_addr: str,
+        *,
+        interval_s: float = 1.0,
+        max_kills: Optional[int] = None,
+        seed: Optional[int] = None,
+    ):
+        self.controller_addr = controller_addr
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.kills: List[int] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "WorkerKiller":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="chaos-worker-killer"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self.max_kills is not None and len(self.kills) >= self.max_kills:
+                return
+            pids = find_worker_pids(self.controller_addr)
+            if not pids:
+                continue
+            pid = self._rng.choice(pids)
+            try:
+                os.kill(pid, signal.SIGKILL)
+                self.kills.append(pid)
+            except OSError:
+                pass  # already gone
+
+    def stop(self) -> List[int]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        return self.kills
+
+
+class NodeKiller:
+    """Periodically hard-kills a random non-head node of a
+    ``cluster_utils.Cluster`` and (optionally) replaces it — the
+    elastic-membership half of the reference's ``NodeKillerBase``."""
+
+    def __init__(
+        self,
+        cluster,
+        *,
+        interval_s: float = 3.0,
+        replace: bool = True,
+        node_resources: Optional[dict] = None,
+        num_cpus: float = 1,
+        max_kills: Optional[int] = None,
+        seed: Optional[int] = None,
+    ):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.replace = replace
+        self.node_resources = node_resources
+        self.num_cpus = num_cpus
+        self.max_kills = max_kills
+        self.kills = 0
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="chaos-node-killer"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self.max_kills is not None and self.kills >= self.max_kills:
+                return
+            nodes = list(self.cluster.nodes)
+            if not nodes:
+                continue
+            node = self._rng.choice(nodes)
+            try:
+                self.cluster.remove_node(node)
+                self.kills += 1
+            except Exception:
+                continue
+            if self.replace:
+                self.cluster.add_node(
+                    num_cpus=self.num_cpus, resources=self.node_resources
+                )
+
+    def stop(self) -> int:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        return self.kills

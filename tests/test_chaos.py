@@ -1,0 +1,193 @@
+"""Chaos tests: workloads must complete CORRECTLY while killers take
+out workers/nodes at random (reference ``_private/test_utils.py:1496``
+killer actors + ``tests/chaos/``). RPC-level chaos (env-configured
+``testing_rpc_failure``) is layered onto the cluster fixture so every
+retried control-plane RPC path also gets exercised.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.chaos import NodeKiller, WorkerKiller, find_worker_pids
+
+
+@pytest.fixture()
+def chaos_cluster(monkeypatch):
+    # inject retryable RPC failures into every daemon/worker the cluster
+    # spawns (subprocess env inherits): 8% of task/actor pushes fail
+    # with a transient (ChaosInjectedError) the submitters must retry.
+    # monkeypatch scopes the env var even if setup below raises.
+    monkeypatch.setenv("RAY_TPU_testing_rpc_failure", "push_batch:0.08")
+    cluster = None
+    try:
+        cluster = Cluster(num_cpus=2)
+        ray_tpu.init(address=cluster.address)
+        yield cluster
+    finally:
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        monkeypatch.delenv("RAY_TPU_testing_rpc_failure", raising=False)
+        GLOBAL_CONFIG.reset()
+
+
+def _controller_addr(cluster: Cluster) -> str:
+    return f"127.0.0.1:{cluster.controller_port}"
+
+
+def test_lineage_task_graph_under_worker_chaos(chaos_cluster):
+    """A dependency graph of retryable tasks completes with the right
+    answer while a killer SIGKILLs workers (task retries + lineage
+    reconstruction of lost intermediate objects)."""
+
+    @ray_tpu.remote(max_retries=5, num_cpus=0.5)
+    def square(x):
+        time.sleep(0.05)
+        return x * x
+
+    @ray_tpu.remote(max_retries=5, num_cpus=0.5)
+    def add(a, b):
+        time.sleep(0.05)
+        return a + b
+
+    killer = WorkerKiller(
+        _controller_addr(chaos_cluster), interval_s=0.7, max_kills=6, seed=1
+    ).start()
+    try:
+        # two fan-in layers: leaf results feed sums (lineage deps)
+        leaves = [square.remote(i) for i in range(12)]
+        sums = [add.remote(leaves[i], leaves[i + 1]) for i in range(0, 12, 2)]
+        total = ray_tpu.get(
+            [add.remote(sums[i], sums[i + 1]) for i in range(0, 6, 2)],
+            timeout=240,
+        )
+    finally:
+        kills = killer.stop()
+    expect = [sum(j * j for j in range(k, k + 4)) for k in range(0, 12, 4)]
+    assert total == expect, (total, expect)
+    assert kills, "killer never fired — chaos was a no-op"
+
+
+def test_actor_workload_under_worker_chaos(chaos_cluster):
+    """Restartable actors keep answering correctly while their worker
+    processes are SIGKILLed (actor-restart FSM + task retries)."""
+
+    @ray_tpu.remote(max_restarts=-1, max_task_retries=8, num_cpus=0.5)
+    class Counter:
+        def __init__(self):
+            self.mine = 0
+
+        def bump(self, x):
+            time.sleep(0.03)
+            self.mine += 1
+            return x * 2
+
+    actors = [Counter.remote() for _ in range(2)]
+    # warm them up so the killer has targets
+    ray_tpu.get([a.bump.remote(0) for a in actors], timeout=120)
+    killer = WorkerKiller(
+        _controller_addr(chaos_cluster), interval_s=0.8, max_kills=5, seed=2
+    ).start()
+    try:
+        results = []
+        for i in range(30):
+            results.append(
+                ray_tpu.get(actors[i % 2].bump.remote(i), timeout=180)
+            )
+    finally:
+        kills = killer.stop()
+    assert results == [i * 2 for i in range(30)]
+    assert kills, "killer never fired — chaos was a no-op"
+
+
+def test_trainer_completes_under_node_chaos():
+    """JaxTrainer + FailureConfig: training restarts from the latest
+    checkpoint when the node hosting a train worker dies mid-run, and
+    still converges (reference: Train fault tolerance =
+    restart-worker-group-from-checkpoint)."""
+    cluster = Cluster(num_cpus=1)
+    cluster.add_node(num_cpus=2, resources={"trainer": 2})
+    time.sleep(1.0)
+    ray_tpu.init(address=cluster.address)
+    try:
+        from ray_tpu.train import (
+            FailureConfig,
+            JaxTrainer,
+            RunConfig,
+            ScalingConfig,
+        )
+        from ray_tpu import train
+
+        def train_fn(config):
+            w = 0.0
+            start = 0
+            ckpt = train.get_checkpoint()
+            if ckpt is not None:
+                state = ckpt.to_dict()
+                w, start = state["w"], state["step"]
+            for step in range(start, 12):
+                time.sleep(0.4)
+                w += 1.0
+                train.report(
+                    {"w": w, "step": step + 1},
+                    checkpoint=train.Checkpoint.from_dict(
+                        {"w": w, "step": step + 1}
+                    ),
+                )
+            # a restart can resume AT step 12 (killed after the final
+            # checkpoint): the loop is empty, so report final state
+            # unconditionally or the run ends metric-less
+            train.report({"w": w, "step": 12})
+
+        trainer = JaxTrainer(
+            train_fn,
+            train_loop_config={},
+            scaling_config=ScalingConfig(
+                num_workers=1,
+                resources_per_worker={"CPU": 1, "trainer": 1},
+            ),
+            run_config=RunConfig(
+                # unique name: a fixed one resumes a PRIOR test run's
+                # persisted checkpoint and finishes before the killer fires
+                name=f"chaos-train-{os.getpid()}-{int(time.time()*1000)}",
+                failure_config=FailureConfig(max_failures=4),
+            ),
+        )
+        killer = NodeKiller(
+            cluster,
+            interval_s=2.0,
+            replace=True,
+            node_resources={"trainer": 2},
+            num_cpus=2,
+            max_kills=1,
+            seed=3,
+        ).start()
+        try:
+            result = trainer.fit()
+        finally:
+            kills = killer.stop()
+        assert result.metrics["w"] == 12.0
+        assert kills >= 1, "node killer never fired"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_find_worker_pids_scopes_to_cluster(chaos_cluster):
+    """The pid scanner must only see THIS cluster's workers."""
+
+    @ray_tpu.remote(num_cpus=0.5)
+    def touch():
+        return os.getpid()
+
+    pid = ray_tpu.get(touch.remote(), timeout=120)
+    pids = find_worker_pids(_controller_addr(chaos_cluster))
+    assert pid in pids
+    assert find_worker_pids("127.0.0.1:1") == []
