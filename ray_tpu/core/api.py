@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import atexit
 import contextvars
+import os
 import threading
 import time
 from abc import ABC, abstractmethod
@@ -130,6 +131,7 @@ class Worker:
         self.address: Optional[Address] = None  # set by cluster runtime
         self._put_counter = 0
         self._task_counter = 0
+        self._packaged_envs: Dict[Any, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         self.fn_table = FunctionTable(backend.kv_put, backend.kv_get)
         set_refcount_hooks(self._on_ref_created, self._on_ref_deleted, self._on_ref_borrowed)
@@ -293,6 +295,29 @@ class Worker:
             if opts.max_retries is not None
             else (GLOBAL_CONFIG.task_max_retries if kind == TaskKind.NORMAL else 0)
         )
+        runtime_env = opts.runtime_env
+        if runtime_env:
+            from ray_tpu.runtime_env import validate_runtime_env
+
+            validate_runtime_env(runtime_env)  # fail at submit, not on-worker
+        if runtime_env and any(
+            k in runtime_env for k in ("working_dir", "py_modules")
+        ):
+            # ship code at submission: zip -> content-addressed KV upload;
+            # workers extract per hash (runtime_env/packaging.py). Cached
+            # per (paths identity) on this worker via the packaged dict.
+            from ray_tpu.runtime_env import package_runtime_env
+
+            key = tuple(sorted(
+                (k, str(v)) for k, v in runtime_env.items()
+            ))
+            packaged = self._packaged_envs.get(key)
+            if packaged is None:
+                packaged = package_runtime_env(
+                    runtime_env, self.backend.kv_put, self.backend.kv_get
+                )
+                self._packaged_envs[key] = packaged
+            runtime_env = packaged
         if num_returns == "streaming":
             # re-executing a partially-consumed stream has replay
             # semantics this build doesn't implement — no retries
@@ -312,7 +337,7 @@ class Worker:
             owner=self.address,
             max_retries=max_retries,
             retry_exceptions=opts.retry_exceptions,
-            runtime_env=opts.runtime_env,
+            runtime_env=runtime_env,
             actor_id=actor_id,
             max_restarts=opts.max_restarts,
             max_task_retries=opts.max_task_retries,
@@ -418,6 +443,10 @@ def init(
     everything eagerly in the driver process.
     """
     global _worker
+    if address is None:
+        # job entrypoints get the cluster address injected by their
+        # supervisor (reference: RAY_ADDRESS; job/supervisor.py)
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
     with _worker_lock:
         if _worker is not None:
             if ignore_reinit_error:

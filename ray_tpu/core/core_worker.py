@@ -50,7 +50,13 @@ from ray_tpu.core.object_store import MemoryStore, StoreClient
 from ray_tpu.core.object_store import segment_name as _segment_name
 from ray_tpu.core.ownership import ObjState, ReferenceCounter
 from ray_tpu.core.refs import Address, ObjectRef
-from ray_tpu.core.rpc import ConnectionLost, IoThread, RpcClient, RpcServer
+from ray_tpu.core.rpc import (
+    ChaosInjectedError,
+    ConnectionLost,
+    IoThread,
+    RpcClient,
+    RpcServer,
+)
 from ray_tpu.core.task_spec import TaskKind, TaskSpec
 
 logger = logging.getLogger(__name__)
@@ -786,6 +792,13 @@ class CoreWorker(RuntimeBackend):
                     timeout=None,
                     connect_timeout=3.0,
                 )
+            except ChaosInjectedError:
+                # injected BEFORE the handler ran: re-push on the same
+                # (healthy) lease without consuming task retries
+                for spec in reversed(batch):
+                    q.specs.appendleft(spec)
+                await asyncio.sleep(0.02)
+                continue
             except ConnectionLost:
                 for spec in batch:
                     tid = spec.task_id.binary()
@@ -1316,6 +1329,11 @@ class CoreWorker(RuntimeBackend):
                     reply = await client.call(
                         "push_batch", {"specs": batch}, timeout=None, connect_timeout=3.0
                     )
+                except ChaosInjectedError:
+                    # pre-execution injection: retry the batch, actor is
+                    # fine and no task retry budget is consumed
+                    await asyncio.sleep(0.02)
+                    continue
                 except ConnectionLost:
                     # controller consult is NOT guarded: if the control
                     # plane is also gone there is nothing to wait for —
@@ -1397,6 +1415,9 @@ class CoreWorker(RuntimeBackend):
                     )
                 try:
                     reply = await client.call("push_task", {"spec": spec}, timeout=None, connect_timeout=3.0)
+                except ChaosInjectedError:
+                    await asyncio.sleep(0.02)
+                    continue
                 except ConnectionLost:
                     # actor possibly restarting — consult the controller
                     info = await self.controller.call("get_actor_info", {"actor_id": spec.actor_id})
@@ -1621,6 +1642,9 @@ class CoreWorker(RuntimeBackend):
 
     def kv_keys(self, prefix: bytes = b"") -> List[bytes]:
         return self.io.run(self.controller.call("kv_keys", {"prefix": prefix}))
+
+    def kv_del(self, key: bytes) -> None:
+        self.io.run(self.controller.call("kv_del", {"key": key}))
 
     def cluster_resources(self) -> Dict[str, float]:
         return self.io.run(self.controller.call("cluster_resources"))

@@ -298,6 +298,10 @@ class LocalBackend(RuntimeBackend):
         with self._lock:
             return [k for k in self._kv if k.startswith(prefix)]
 
+    def kv_del(self, key: bytes) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
+
     def cluster_resources(self) -> Dict[str, float]:
         return dict(self._resources)
 

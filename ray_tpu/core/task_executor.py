@@ -319,27 +319,24 @@ class TaskExecutor:
         avoids this class of problem entirely by dedicating workers per
         runtime env, which is the upgrade path here too."""
         env = spec.runtime_env or {}
-        env_vars = env.get("env_vars")
-        if not env_vars:
+        if not env:
             return None
-        if not isinstance(env_vars, dict):
-            raise ValueError(f"env_vars must be a dict, got {type(env_vars).__name__}")
-        if spec.kind != TaskKind.NORMAL or spec.actor_id is not None:
-            os.environ.update({k: str(v) for k, v in env_vars.items()})
+        from ray_tpu.runtime_env import apply_runtime_env
+
+        permanent = spec.kind != TaskKind.NORMAL or spec.actor_id is not None
+        restores = apply_runtime_env(
+            env, self.api_worker.backend.kv_get, permanent=permanent
+        )
+        if not restores:
             return None
         self._env_gen += 1
         my_gen = self._env_gen
-        saved = {k: os.environ.get(k) for k in env_vars}
-        os.environ.update({k: str(v) for k, v in env_vars.items()})
 
         def restore():
             if self._env_gen != my_gen:
-                return  # a newer task re-applied env vars: don't clobber
-            for k, old in saved.items():
-                if old is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = old
+                return  # a newer task re-applied an env: don't clobber
+            for r in restores:
+                r()
 
         return restore
 

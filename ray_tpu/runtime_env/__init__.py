@@ -1,0 +1,219 @@
+"""Runtime environments: per-task/actor env shipping behind a plugin ABC.
+
+Reference: ``python/ray/_private/runtime_env/plugin.py:24``
+(``RuntimeEnvPlugin``) + the pip/conda/working_dir/py_modules plugins
+and the per-node agent. TPU-native compression: no separate agent
+process — the driver PACKAGES (zip → content-addressed controller-KV
+upload) at submission, the executing worker APPLIES (download → per-hash
+cache extract → sys.path/cwd) before running the task, both through the
+plugin registry here.
+
+    @ray_tpu.remote(runtime_env={"working_dir": "./my_project",
+                                 "py_modules": ["./libs/helper"],
+                                 "env_vars": {"TOKENIZERS_PARALLELISM": "false"}})
+    def train(): ...
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.runtime_env.packaging import (
+    URI_PREFIX,
+    ensure_local,
+    upload_package,
+    zip_directory,
+)
+
+
+class RuntimeEnvPlugin:
+    """One runtime_env key (reference ``plugin.py:24``). Driver-side
+    ``package`` rewrites the value for the wire (uploading code);
+    worker-side ``apply`` realizes it and returns a restore callable
+    (or None when nothing needs undoing)."""
+
+    name: str = ""
+    priority: int = 50  # lower applies first
+
+    def validate(self, value: Any) -> None:
+        pass
+
+    def package(self, value: Any, kv_put: Callable, kv_get: Callable) -> Any:
+        return value
+
+    def apply(
+        self, value: Any, kv_get: Callable, *, permanent: bool
+    ) -> Optional[Callable[[], None]]:
+        return None
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 10
+
+    def validate(self, value: Any) -> None:
+        if not isinstance(value, dict):
+            raise ValueError(f"env_vars must be a dict, got {type(value).__name__}")
+
+    def apply(self, value, kv_get, *, permanent: bool):
+        saved = {k: os.environ.get(k) for k in value}
+        os.environ.update({k: str(v) for k, v in value.items()})
+        if permanent:
+            return None
+
+        def restore():
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+
+        return restore
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    """Ship the driver's project directory (reference working_dir
+    plugin): zipped at submit, extracted per-hash on the worker, put at
+    the FRONT of sys.path; dedicated (actor) workers also chdir into it."""
+
+    name = "working_dir"
+    priority = 20
+
+    def validate(self, value: Any) -> None:
+        if not isinstance(value, str):
+            raise ValueError("working_dir must be a path or kvpkg:// uri")
+        if not value.startswith(URI_PREFIX) and not os.path.isdir(value):
+            raise ValueError(f"working_dir {value!r} is not a directory")
+
+    def package(self, value: str, kv_put, kv_get) -> str:
+        if value.startswith(URI_PREFIX):
+            return value
+        return upload_package(kv_put, kv_get, zip_directory(value))
+
+    def apply(self, value: str, kv_get, *, permanent: bool):
+        target = ensure_local(kv_get, value)
+        sys.path.insert(0, target)
+        prev_cwd = None
+        if permanent:
+            prev_cwd = os.getcwd()
+            os.chdir(target)
+            return None
+
+        def restore():
+            try:
+                sys.path.remove(target)
+            except ValueError:
+                pass
+
+        return restore
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    """Ship importable modules (reference py_modules plugin): each entry
+    is a package directory or single .py file; the worker extracts each
+    and adds a directory CONTAINING the module to sys.path."""
+
+    name = "py_modules"
+    priority = 30
+
+    def validate(self, value: Any) -> None:
+        if not isinstance(value, (list, tuple)):
+            raise ValueError("py_modules must be a list of paths/uris")
+        for v in value:
+            if not isinstance(v, str):
+                raise ValueError("py_modules entries must be strings")
+            if not v.startswith(URI_PREFIX) and not os.path.exists(v):
+                raise ValueError(f"py_modules entry {v!r} does not exist")
+
+    def package(self, value, kv_put, kv_get):
+        out = []
+        for v in value:
+            if v.startswith(URI_PREFIX):
+                out.append(v)
+                continue
+            # a directory keeps its top-level name in the zip so that
+            # `import <name>` works from the extraction root
+            data = zip_directory(v, include_root=os.path.isdir(v))
+            out.append(upload_package(kv_put, kv_get, data))
+        return out
+
+    def apply(self, value, kv_get, *, permanent: bool):
+        added = []
+        for uri in value:
+            target = ensure_local(kv_get, uri)
+            sys.path.insert(0, target)
+            added.append(target)
+        if permanent:
+            return None
+
+        def restore():
+            for t in added:
+                try:
+                    sys.path.remove(t)
+                except ValueError:
+                    pass
+
+        return restore
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    _PLUGINS[plugin.name] = plugin
+
+
+for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin()):
+    register_plugin(_p)
+
+
+def validate_runtime_env(env: Dict[str, Any]) -> None:
+    for key, value in env.items():
+        plugin = _PLUGINS.get(key)
+        if plugin is None:
+            raise ValueError(
+                f"unknown runtime_env key {key!r} "
+                f"(known: {sorted(_PLUGINS)})"
+            )
+        plugin.validate(value)
+
+
+def package_runtime_env(
+    env: Dict[str, Any], kv_put: Callable, kv_get: Callable
+) -> Dict[str, Any]:
+    """Driver side: validate + upload local code, returning the
+    wire-form env (local paths replaced by kvpkg:// uris)."""
+    validate_runtime_env(env)
+    return {
+        key: _PLUGINS[key].package(value, kv_put, kv_get)
+        for key, value in env.items()
+    }
+
+
+def apply_runtime_env(
+    env: Dict[str, Any], kv_get: Callable, *, permanent: bool
+) -> List[Callable[[], None]]:
+    """Worker side: realize every key (priority order); returns restore
+    callables (reverse-apply order)."""
+    restores: List[Callable[[], None]] = []
+    try:
+        for key in sorted(env, key=lambda k: _PLUGINS[k].priority if k in _PLUGINS else 99):
+            plugin = _PLUGINS.get(key)
+            if plugin is None:
+                raise ValueError(f"unknown runtime_env key {key!r}")
+            r = plugin.apply(env[key], kv_get, permanent=permanent)
+            if r is not None:
+                restores.append(r)
+    except BaseException:
+        # a later plugin failing must not leak earlier plugins' effects
+        # (env vars / sys.path entries) into the shared pooled worker
+        for r in reversed(restores):
+            try:
+                r()
+            except Exception:
+                pass
+        raise
+    restores.reverse()
+    return restores

@@ -1,0 +1,98 @@
+"""Runtime-env packaging: zip local code, ship via controller KV, cache
+per-hash on workers.
+
+Reference: ``python/ray/_private/runtime_env/packaging.py`` — local
+``working_dir``/``py_modules`` paths zip deterministically, upload once
+(content-addressed ``kvpkg://{sha1}``), and extract into a per-hash
+cache directory on each node; concurrent extractions are made atomic by
+extract-to-temp + rename.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import shutil
+import tempfile
+import zipfile
+from typing import Callable, List
+
+URI_PREFIX = "kvpkg://"
+_KV_PREFIX = b"runtime_env_pkg:"
+#: reference cap (GCS_STORAGE_MAX_SIZE); the KV lives in controller memory
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+_CACHE_ROOT = "/tmp/ray_tpu/runtime_env"
+
+
+def zip_directory(path: str, *, include_root: bool = False) -> bytes:
+    """Deterministic zip of a directory tree (or a single file).
+    ``include_root=True`` keeps the directory's own name as the zip's
+    top level (py_modules: ``import <name>`` works from the extraction
+    root); otherwise the zip is rooted at the directory's contents."""
+    path = os.path.abspath(path)
+    arc_base = os.path.dirname(path) if include_root else path
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(path):
+            zf.write(path, os.path.basename(path))
+        else:
+            entries: List[str] = []
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+                for f in sorted(files):
+                    entries.append(os.path.join(root, f))
+            for f in entries:
+                zf.write(f, os.path.relpath(f, arc_base))
+    data = buf.getvalue()
+    if len(data) > MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"packaged {path!r} is {len(data)} bytes "
+            f"(cap {MAX_PACKAGE_BYTES}); exclude large data from "
+            "working_dir/py_modules"
+        )
+    return data
+
+
+def package_uri(data: bytes) -> str:
+    return URI_PREFIX + hashlib.sha1(data).hexdigest()
+
+
+def upload_package(kv_put: Callable, kv_get: Callable, data: bytes) -> str:
+    """Content-addressed upload: skip if the hash is already there."""
+    uri = package_uri(data)
+    key = _KV_PREFIX + uri[len(URI_PREFIX):].encode()
+    if kv_get(key) is None:
+        kv_put(key, data)
+    return uri
+
+
+def ensure_local(kv_get: Callable, uri: str) -> str:
+    """Worker side: download + extract once per hash; returns the
+    extracted directory. Atomic against concurrent workers via
+    extract-to-temp + rename."""
+    if not uri.startswith(URI_PREFIX):
+        raise ValueError(f"not a package uri: {uri!r}")
+    digest = uri[len(URI_PREFIX):]
+    target = os.path.join(_CACHE_ROOT, digest)
+    if os.path.isdir(target):
+        return target
+    data = kv_get(_KV_PREFIX + digest.encode())
+    if data is None:
+        raise FileNotFoundError(f"runtime-env package {uri} not in cluster KV")
+    os.makedirs(_CACHE_ROOT, exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=_CACHE_ROOT, prefix=f".{digest}-")
+    try:
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            zf.extractall(tmp)
+        try:
+            os.rename(tmp, target)
+        except OSError:
+            # concurrent extractor won the rename — use theirs
+            shutil.rmtree(tmp, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return target

@@ -40,7 +40,9 @@ def find_worker_pids(controller_addr: str) -> List[int]:
                 continue
             with open(f"/proc/{pid}/environ", "rb") as f:
                 env = f.read().decode(errors="replace")
-            if f"RAY_TPU_CONTROLLER_ADDR={controller_addr}" in env:
+            # environ entries are NUL-separated: match the full value or
+            # ':812' would also claim another cluster's ':8123' workers
+            if f"RAY_TPU_CONTROLLER_ADDR={controller_addr}\x00" in env:
                 out.append(pid)
         except (OSError, PermissionError):
             continue  # raced process exit

@@ -1,0 +1,150 @@
+"""Job submission: REST submit/status/logs/stop + supervisor lifecycle
+(reference ``dashboard/modules/job/``: ``job_manager.py:59``,
+``job_supervisor.py:54``, ``sdk.py:125``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job import JobSubmissionClient, start_job_server, stop_job_server
+
+
+@pytest.fixture(scope="module")
+def client():
+    ray_tpu.init(num_cpus=4)
+    server = start_job_server(port=0)  # ephemeral port
+    yield JobSubmissionClient(f"http://127.0.0.1:{server.port}")
+    stop_job_server()
+    ray_tpu.shutdown()
+
+
+def test_job_succeeds_with_logs(client):
+    job_id = client.submit_job(
+        entrypoint="python -c \"print('hello from job'); print('line two')\""
+    )
+    assert client.get_job_status(job_id) in ("PENDING", "RUNNING", "SUCCEEDED")
+    status = client.wait_until_terminal(job_id, timeout=120)
+    assert status == "SUCCEEDED"
+    logs = client.get_job_logs(job_id)
+    assert "hello from job" in logs and "line two" in logs
+    info = client.get_job_info(job_id)
+    assert info["entrypoint"].startswith("python -c")
+    assert info["end_time"] >= info["start_time"]
+
+
+def test_job_failure_surfaces(client):
+    job_id = client.submit_job(
+        entrypoint="python -c \"import sys; print('about to die'); sys.exit(3)\""
+    )
+    assert client.wait_until_terminal(job_id, timeout=120) == "FAILED"
+    info = client.get_job_info(job_id)
+    assert "code 3" in info["message"]
+    assert "about to die" in client.get_job_logs(job_id)
+
+
+def test_job_entrypoint_retries(client):
+    """A flaky entrypoint succeeds on retry (reference
+    entrypoint_num_retries): first attempt fails on a marker file."""
+    import tempfile, os
+
+    marker = tempfile.mktemp()
+    script = (
+        "import os,sys;"
+        f"p={marker!r};"
+        "first=not os.path.exists(p);"
+        "open(p,'w').write('x');"
+        "print('attempt', 'first' if first else 'second');"
+        "sys.exit(1 if first else 0)"
+    )
+    job_id = client.submit_job(
+        entrypoint=f'python -c "{script}"', entrypoint_num_retries=2
+    )
+    assert client.wait_until_terminal(job_id, timeout=120) == "SUCCEEDED"
+    logs = client.get_job_logs(job_id)
+    assert "attempt first" in logs and "attempt second" in logs
+    assert "entrypoint retry 1/2" in logs
+    os.unlink(marker)
+
+
+def test_job_stop(client):
+    job_id = client.submit_job(
+        entrypoint="python -c \"import time; print('sleeping',flush=True); time.sleep(600)\""
+    )
+    deadline = time.monotonic() + 60
+    while client.get_job_status(job_id) != "RUNNING":
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+    # wait for the subprocess to actually print (it exists by then)
+    while "sleeping" not in client.get_job_logs(job_id):
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+    assert client.stop_job(job_id)
+    assert client.wait_until_terminal(job_id, timeout=60) == "STOPPED"
+
+
+def test_job_runs_cluster_workload(client):
+    """The entrypoint connects back to THIS cluster via the injected
+    RAY_TPU_ADDRESS and talks to an actor the SUBMITTING driver created
+    — proof it joined this cluster rather than booting its own."""
+    import ray_tpu
+
+    @ray_tpu.remote(name="job_witness", lifetime="detached", num_cpus=0)
+    class Witness:
+        def ping(self):
+            return "seen-by-job"
+
+    w = Witness.remote()
+    ns = ray_tpu.get_runtime_context().namespace
+    script = (
+        "import os,ray_tpu;"
+        "assert os.environ.get('RAY_TPU_ADDRESS'), 'no cluster address injected';"
+        "ray_tpu.init();"  # address from RAY_TPU_ADDRESS
+        f"a=ray_tpu.get_actor('job_witness', namespace='{ns}');"
+        "print('witness', ray_tpu.get(a.ping.remote(), timeout=60));"
+        "f=ray_tpu.remote(lambda x: x*7);"
+        "print('answer', ray_tpu.get(f.remote(6), timeout=60));"
+        "ray_tpu.shutdown()"
+    )
+    job_id = client.submit_job(entrypoint=f'python -c "{script}"')
+    try:
+        assert client.wait_until_terminal(job_id, timeout=180) == "SUCCEEDED", (
+            client.get_job_logs(job_id)
+        )
+        logs = client.get_job_logs(job_id)
+        assert "witness seen-by-job" in logs
+        assert "answer 42" in logs
+    finally:
+        ray_tpu.kill(w)
+
+
+def test_job_list_and_delete(client):
+    job_id = client.submit_job(entrypoint="python -c \"print('quick')\"")
+    client.wait_until_terminal(job_id, timeout=120)
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+    assert client.delete_job(job_id)
+    assert all(j["job_id"] != job_id for j in client.list_jobs())
+    with pytest.raises(RuntimeError, match="404"):
+        client.get_job_status(job_id)
+
+
+def test_duplicate_submission_id_rejected(client):
+    job_id = client.submit_job(entrypoint="python -c \"print('a')\"")
+    with pytest.raises(RuntimeError, match="409"):
+        client.submit_job(entrypoint="echo x", submission_id=job_id)
+    client.wait_until_terminal(job_id, timeout=120)
+
+
+def test_tail_job_logs(client):
+    script = (
+        "import time\n"
+        "for i in range(5):\n"
+        "    print('tick', i, flush=True)\n"
+        "    time.sleep(0.3)\n"
+    )
+    job_id = client.submit_job(entrypoint=f"python -c \"{script}\"")
+    chunks = list(client.tail_job_logs(job_id, poll_s=0.2))
+    full = "".join(chunks)
+    for i in range(5):
+        assert f"tick {i}" in full
+    assert len(chunks) >= 2  # actually incremental, not one dump

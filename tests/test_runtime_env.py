@@ -1,0 +1,141 @@
+"""Runtime-env code shipping: working_dir / py_modules zip -> controller
+KV -> worker-side per-hash extract + sys.path (reference
+``_private/runtime_env/packaging.py`` behind the ``plugin.py:24`` ABC)."""
+
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture()
+def project(tmp_path):
+    """A driver-only 'project': a module + a package that exist nowhere
+    on the workers' import path."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "driver_only_mod.py").write_text(
+        "SECRET = 'from-working-dir'\n"
+        "def shout():\n"
+        "    return SECRET.upper()\n"
+    )
+    (proj / "datafile.txt").write_text("payload-bytes")
+    lib = tmp_path / "libs" / "driver_only_pkg"
+    lib.mkdir(parents=True)
+    (lib / "__init__.py").write_text("NAME = 'driver-only-pkg'\n")
+    (lib / "inner.py").write_text("def nine():\n    return 9\n")
+    return proj, lib
+
+
+def test_working_dir_ships_to_second_node(project):
+    """The VERDICT done-criterion: a task scheduled on a SECOND node
+    imports a module that exists only in the driver's working_dir."""
+    proj, _lib = project
+    cluster = Cluster(num_cpus=1)
+    cluster.add_node(num_cpus=2, resources={"other": 2})
+    time.sleep(1.0)
+    ray_tpu.init(address=cluster.address)
+    try:
+
+        @ray_tpu.remote(
+            num_cpus=1,
+            resources={"other": 1},  # forces the second node
+            runtime_env={"working_dir": str(proj)},
+        )
+        def use_module():
+            import driver_only_mod
+
+            # working_dir contents are also present as files for
+            # dedicated workers; pooled task workers get sys.path
+            return driver_only_mod.shout()
+
+        assert ray_tpu.get(use_module.remote(), timeout=120) == "FROM-WORKING-DIR"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+@pytest.fixture(scope="module")
+def local_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_py_modules_package_and_file(local_cluster, tmp_path):
+    lib = tmp_path / "libs" / "only_pkg"
+    lib.mkdir(parents=True)
+    (lib / "__init__.py").write_text("VALUE = 31\n")
+    single = tmp_path / "only_file.py"
+    single.write_text("def f():\n    return 'single-file'\n")
+
+    @ray_tpu.remote(
+        runtime_env={"py_modules": [str(lib), str(single)]}
+    )
+    def use_both():
+        import only_pkg
+        import only_file
+
+        return only_pkg.VALUE, only_file.f()
+
+    assert ray_tpu.get(use_both.remote(), timeout=120) == (31, "single-file")
+
+
+def test_working_dir_actor_chdir(local_cluster, tmp_path):
+    """Dedicated actor workers chdir into the extracted working_dir —
+    relative file access works (reference working_dir semantics)."""
+    proj = tmp_path / "actorproj"
+    proj.mkdir()
+    (proj / "config.txt").write_text("chdir-proof")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    class Reader:
+        def read(self):
+            with open("config.txt") as f:
+                return f.read()
+
+    r = Reader.remote()
+    assert ray_tpu.get(r.read.remote(), timeout=120) == "chdir-proof"
+    ray_tpu.kill(r)
+
+
+def test_runtime_env_validation_errors(local_cluster, tmp_path):
+    with pytest.raises(ValueError, match="not a directory"):
+        @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path / "nope")})
+        def f():
+            return 1
+
+        f.remote()
+    with pytest.raises(ValueError, match="unknown runtime_env key"):
+        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        def g():
+            return 1
+
+        g.remote()
+
+
+def test_package_cache_single_upload(local_cluster, tmp_path):
+    """Same working_dir twice → one KV package (content-addressed)."""
+    proj = tmp_path / "cachedproj"
+    proj.mkdir()
+    (proj / "m.py").write_text("X = 1\n")
+
+    from ray_tpu.core.api import _global_worker
+
+    before = len(_global_worker().backend.kv_keys(b"runtime_env_pkg:"))
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def one():
+        import m
+
+        return m.X
+
+    assert ray_tpu.get(one.remote(), timeout=120) == 1
+    assert ray_tpu.get(one.remote(), timeout=120) == 1
+    after = len(_global_worker().backend.kv_keys(b"runtime_env_pkg:"))
+    assert after - before == 1  # two submissions, one content-addressed pkg
