@@ -272,6 +272,32 @@ def bench_tpu(results: Dict[str, Dict]) -> None:
         )
         print(f"  flash_fwdbwd_s{s}: {results[f'flash_fwdbwd_s{s}_tflops']}", file=sys.stderr, flush=True)
 
+    # CNN forward (the DQN/Atari image path): conv stack throughput on
+    # the MXU (reference rllib CNN defaults; ray_tpu.rl.models)
+    from ray_tpu.rl.models import apply_cnn_q, init_cnn
+
+    bb, hh, ww, cc = (256, 84, 84, 4) if on_tpu else (8, 16, 16, 3)
+    cnn_params = init_cnn(jax.random.PRNGKey(3), (hh, ww, cc), 6, heads=("q",))
+    if on_tpu:
+        cnn_params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+            cnn_params,
+        )
+    obs0 = jax.random.uniform(jax.random.PRNGKey(4), (bb, hh, ww, cc),
+                              jnp.bfloat16 if on_tpu else jnp.float32)
+
+    def cnn_step(x, _k, _v):
+        q = apply_cnn_q(cnn_params, x)
+        # zero-weight data dep chains the iterations without growing x
+        return x + (0 * q.sum()).astype(x.dtype)
+
+    iters = 60 if on_tpu else 5
+    dt = _bench_chained(cnn_step, obs0, obs0, obs0, iters=iters)
+    results["cnn_forward_images_per_s"] = _maybe_invalid(
+        {"value": round(bb / dt, 1), "unit": "images/s (84x84x4 batch 256)"}, dt
+    )
+    print(f"  cnn_forward_images_per_s: {results['cnn_forward_images_per_s']}", file=sys.stderr, flush=True)
+
     # Llama train step on one chip: the largest config that comfortably
     # fits a single chip's HBM (so remat/donation/layout decisions are
     # actually exercised), with MFU against the chip peak.

@@ -304,12 +304,15 @@ class Worker:
             k in runtime_env for k in ("working_dir", "py_modules")
         ):
             # ship code at submission: zip -> content-addressed KV upload;
-            # workers extract per hash (runtime_env/packaging.py). Cached
-            # per (paths identity) on this worker via the packaged dict.
+            # workers extract per hash (runtime_env/packaging.py). The
+            # driver-side cache keys on path + a tree mtime/size signature
+            # so EDITING the directory re-ships it (path-only keying
+            # would silently pin the first upload for the driver's life).
             from ray_tpu.runtime_env import package_runtime_env
 
             key = tuple(sorted(
-                (k, str(v)) for k, v in runtime_env.items()
+                (k, str(v), _tree_signature(v))
+                for k, v in runtime_env.items()
             ))
             packaged = self._packaged_envs.get(key)
             if packaged is None:
@@ -421,6 +424,29 @@ def is_initialized() -> bool:
 def set_global_worker(worker: Optional[Worker]) -> None:
     global _worker
     _worker = worker
+
+
+def _tree_signature(value) -> int:
+    """Cheap change signature for runtime_env path values: hash of every
+    file's (relpath, mtime_ns, size). Non-path values signature as 0."""
+    paths = value if isinstance(value, (list, tuple)) else [value]
+    sig = 0
+    for p in paths:
+        if not isinstance(p, str) or not os.path.exists(p):
+            continue
+        if os.path.isfile(p):
+            st = os.stat(p)
+            sig = hash((sig, p, st.st_mtime_ns, st.st_size))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs.sort()
+            for f in sorted(files):
+                try:
+                    st = os.stat(os.path.join(root, f))
+                except OSError:
+                    continue
+                sig = hash((sig, os.path.join(root, f), st.st_mtime_ns, st.st_size))
+    return sig
 
 
 def init(

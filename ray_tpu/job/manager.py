@@ -48,6 +48,13 @@ def _derive_cluster_address() -> str:
 class JobManager:
     def __init__(self, cluster_address: str = ""):
         self.cluster_address = cluster_address or _derive_cluster_address()
+        # serializes the duplicate-id check against the PENDING write —
+        # concurrent REST submits share one manager. (Submits from
+        # DIFFERENT processes could still race; a KV compare-and-set
+        # would be needed for that.)
+        import threading
+
+        self._submit_lock = threading.Lock()
 
     def submit_job(
         self,
@@ -59,14 +66,15 @@ class JobManager:
         working_dir: Optional[str] = None,
     ) -> str:
         job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
-        if read_job_status(job_id) is not None:
-            raise ValueError(f"job {job_id!r} already exists")
         # write PENDING synchronously — the supervisor spawn is async and
         # a status poll racing it must see the job, not a 404 (reference:
         # JobManager records the job info row before starting the actor)
         from ray_tpu.job.supervisor import write_job_status
 
-        write_job_status(job_id, entrypoint, JobStatus.PENDING)
+        with self._submit_lock:
+            if read_job_status(job_id) is not None:
+                raise ValueError(f"job {job_id!r} already exists")
+            write_job_status(job_id, entrypoint, JobStatus.PENDING)
         JobSupervisor.options(
             name=_SUPERVISOR_NAME % job_id,
             lifetime="detached",

@@ -172,7 +172,12 @@ class _JobSupervisor:
             return ""
 
     def stop(self) -> bool:
+        """Request a stop. True = the stop took effect (either a live
+        entrypoint was signalled or the run loop will see the flag
+        before/without spawning); False only if the job ALREADY reached
+        a terminal state."""
         with self._proc_lock:
+            already_done = not self._thread.is_alive()
             self._stop_requested = True
             proc = self._proc
         if proc is not None and proc.poll() is None:
@@ -191,7 +196,7 @@ class _JobSupervisor:
 
             threading.Thread(target=_escalate, daemon=True).start()
             return True
-        return False
+        return not already_done
 
     def done(self) -> bool:
         return not self._thread.is_alive()

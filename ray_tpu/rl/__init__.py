@@ -3,19 +3,37 @@
 Reference: ``rllib/`` new API stack (Algorithm / EnvRunnerGroup /
 LearnerGroup). See ``ppo.py`` for the TPU-native design notes."""
 
+from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env_runner import EnvRunner
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.learner_group import LearnerGroup
-from ray_tpu.rl.models import apply_mlp_policy, init_mlp_policy
+from ray_tpu.rl.models import (
+    apply_cnn_policy,
+    apply_cnn_q,
+    apply_mlp_policy,
+    apply_mlp_q,
+    init_cnn,
+    init_mlp_policy,
+    init_mlp_q,
+)
 from ray_tpu.rl.ppo import PPO, PPOConfig
+from ray_tpu.rl.replay import ReplayBuffer
 
 __all__ = [
+    "DQN",
+    "DQNConfig",
     "EnvRunner",
     "IMPALA",
     "IMPALAConfig",
     "LearnerGroup",
     "PPO",
     "PPOConfig",
+    "ReplayBuffer",
+    "apply_cnn_policy",
+    "apply_cnn_q",
     "apply_mlp_policy",
+    "apply_mlp_q",
+    "init_cnn",
     "init_mlp_policy",
+    "init_mlp_q",
 ]

@@ -1,0 +1,225 @@
+"""DQN: value-based learning with a replay buffer.
+
+Reference: ``rllib/algorithms/dqn/`` (DQNConfig/DQN, double-Q +
+target network + replay). TPU-native redesign mirroring ppo.py: the
+learner is ONE jitted update (double-DQN Huber TD loss) over replay
+minibatches; EnvRunner actors collect epsilon-greedy transitions on
+CPU; the target network refreshes by pytree copy every
+``target_update_freq`` gradient steps. ``model="cnn_q"`` runs the conv
+torso for image observations (models.py) — the Atari path.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.replay import ReplayBuffer
+
+
+@dataclass
+class DQNConfig:
+    """Reference ``DQNConfig`` as a dataclass."""
+
+    env: str = "CartPole-v1"
+    env_config: Optional[Dict[str, Any]] = None
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 4
+    rollout_fragment_length: int = 32  # steps per env per iteration
+    model: str = "mlp_q"  # "mlp_q" | "cnn_q"
+    hidden: tuple = (128, 128)
+    gamma: float = 0.99
+    lr: float = 1e-3
+    buffer_capacity: int = 50_000
+    learning_starts: int = 500  # transitions before updates begin
+    train_batch_size: int = 64
+    updates_per_iteration: int = 32
+    target_update_freq: int = 200  # gradient steps between target syncs
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 5_000  # env steps to anneal over
+    double_q: bool = True
+    seed: int = 0
+    runner_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 0.5})
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """EnvRunner gang + jitted double-DQN learner (reference Algorithm)."""
+
+    def __init__(self, config: DQNConfig):
+        import jax
+        import optax
+
+        from ray_tpu.rl.models import init_cnn, init_mlp_q
+        from ray_tpu.rl.utils import make_runners, probe_env_space
+
+        self.config = config
+        obs_shape, num_actions = probe_env_space(config.env, config.env_config)
+        self._num_actions = num_actions
+
+        rng = jax.random.PRNGKey(config.seed)
+        if config.model == "cnn_q":
+            self.params = init_cnn(rng, obs_shape, num_actions, heads=("q",))
+        else:
+            obs_dim = int(np.prod(obs_shape))
+            self.params = init_mlp_q(rng, obs_dim, num_actions, config.hidden)
+        self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.iteration = 0
+        self.env_steps = 0
+        self.gradient_steps = 0
+        self._update = jax.jit(self._make_update())
+
+        self.buffer = ReplayBuffer(config.buffer_capacity, seed=config.seed)
+        self.runners = make_runners(config)
+        self._recent_returns: List[float] = []
+
+    def _apply(self):
+        from ray_tpu.rl.models import apply_cnn_q, apply_mlp_q
+
+        return apply_cnn_q if self.config.model == "cnn_q" else apply_mlp_q
+
+    # -- learner ---------------------------------------------------------
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        apply_q = self._apply()
+
+        def loss_fn(params, target_params, batch):
+            q = apply_q(params, batch["obs"])
+            q_taken = jnp.take_along_axis(q, batch["actions"][:, None], axis=1)[:, 0]
+            q_next_target = apply_q(target_params, batch["next_obs"])
+            if cfg.double_q:
+                # double-DQN: online net picks, target net evaluates
+                q_next_online = apply_q(params, batch["next_obs"])
+                next_a = jnp.argmax(q_next_online, axis=-1)
+                next_q = jnp.take_along_axis(
+                    q_next_target, next_a[:, None], axis=1
+                )[:, 0]
+            else:
+                next_q = q_next_target.max(axis=-1)
+            not_done = 1.0 - batch["dones"].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + cfg.gamma * not_done * next_q
+            )
+            td = q_taken - target
+            loss = optax.huber_loss(td).mean()
+            return loss, (jnp.abs(td).mean(), q_taken.mean())
+
+        def update(params, target_params, opt_state, batch):
+            (loss, (td_abs, q_mean)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, target_params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {
+                "loss": loss,
+                "td_error_abs": td_abs,
+                "q_mean": q_mean,
+            }
+
+        return update
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    # -- Tune trainable surface -----------------------------------------
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        t0 = time.perf_counter()
+        eps = self._epsilon()
+        rollouts = ray_tpu.get(
+            [
+                r.sample_transitions.remote(
+                    self.params, cfg.rollout_fragment_length, eps, cfg.model
+                )
+                for r in self.runners
+            ],
+            timeout=600,
+        )
+        sample_time = time.perf_counter() - t0
+        for ro in rollouts:
+            self._recent_returns.extend(ro.pop("episode_returns"))
+            self.env_steps += len(ro["obs"])
+            self.buffer.add_batch(ro)
+
+        stats: Dict[str, Any] = {}
+        if len(self.buffer) >= max(cfg.learning_starts, cfg.train_batch_size):
+            for _ in range(cfg.updates_per_iteration):
+                batch_np = self.buffer.sample(cfg.train_batch_size)
+                batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+                self.params, self.opt_state, stats = self._update(
+                    self.params, self.target_params, self.opt_state, batch
+                )
+                self.gradient_steps += 1
+                if self.gradient_steps % cfg.target_update_freq == 0:
+                    self.target_params = jax.tree_util.tree_map(
+                        lambda x: x, self.params
+                    )
+        self.iteration += 1
+        self._recent_returns = self._recent_returns[-100:]
+        mean_return = (
+            float(np.mean(self._recent_returns)) if self._recent_returns else 0.0
+        )
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_return,
+            "num_env_steps_sampled": self.env_steps,
+            "num_gradient_steps": self.gradient_steps,
+            "epsilon": round(eps, 4),
+            "buffer_size": len(self.buffer),
+            "sample_time_s": round(sample_time, 3),
+            **{k: float(v) for k, v in stats.items()},
+        }
+
+    # -- state / eval ----------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)
+        return {
+            "params": to_np(self.params),
+            "target_params": to_np(self.target_params),
+            "opt_state": to_np(self.opt_state),
+            "iteration": self.iteration,
+            "env_steps": self.env_steps,
+            "gradient_steps": self.gradient_steps,
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]
+        self.iteration = state["iteration"]
+        self.env_steps = state["env_steps"]
+        self.gradient_steps = state["gradient_steps"]
+
+    def compute_single_action(self, obs) -> int:
+        import jax.numpy as jnp
+
+        q = self._apply()(self.params, jnp.asarray(obs)[None])
+        return int(np.argmax(np.asarray(q)[0]))
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.get(r.close.remote(), timeout=10)
+                ray_tpu.kill(r)
+            except Exception:
+                pass

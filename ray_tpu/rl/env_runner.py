@@ -14,19 +14,55 @@ import numpy as np
 import ray_tpu
 
 
+def resolve_env_class(env_name: str):
+    """``"module.path:EnvClass"`` → the class (importable on any worker
+    by module path — the fake-env CI strategy)."""
+    import importlib
+
+    mod_name, attr = env_name.split(":", 1)
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def make_vector_env(env_name: str, num_envs: int, env_config=None):
+    """Vector env in SAME_STEP autoreset mode: a done step returns the
+    NEW episode's reset obs and the next step is a real transition.
+    gymnasium>=1.0 defaults to NEXT_STEP, whose reset step IGNORES the
+    action and pays reward 0 — recorded naively (as the rollout loops
+    here do), that trains Q/V toward cross-episode garbage."""
+    import gymnasium as gym
+    from gymnasium.vector import AutoresetMode
+
+    if ":" in env_name:
+        cls = resolve_env_class(env_name)
+        cfg = env_config or {}
+        return gym.vector.SyncVectorEnv(
+            [(lambda: cls(**cfg)) for _ in range(num_envs)],
+            autoreset_mode=AutoresetMode.SAME_STEP,
+        )
+    # vectorization_mode="sync" forces the generic SyncVectorEnv — the
+    # per-env custom vector classes (e.g. CartPoleVectorEnv) reject
+    # vector_kwargs and pin their own autoreset behavior
+    return gym.make_vec(
+        env_name,
+        num_envs=num_envs,
+        vectorization_mode="sync",
+        vector_kwargs={"autoreset_mode": AutoresetMode.SAME_STEP},
+        **(env_config or {}),
+    )
+
+
 class _EnvRunner:
     """One rollout actor: a gymnasium vector env + jitted policy apply.
 
     Defined undecorated so cloudpickle exports by module reference."""
 
     def __init__(self, env_name: str, num_envs: int, seed: int, env_config=None):
-        import gymnasium as gym
-
-        self._envs = gym.make_vec(env_name, num_envs=num_envs, **(env_config or {}))
+        self._envs = make_vector_env(env_name, num_envs, env_config)
         self._num_envs = num_envs
         self._obs, _ = self._envs.reset(seed=seed)
         self._rng = np.random.default_rng(seed)
         self._apply = None
+        self._apply_q = None
         self._episode_returns = np.zeros(num_envs)
         self._finished_returns: List[float] = []
 
@@ -93,6 +129,71 @@ class _EnvRunner:
             "logp": np.asarray(logp_buf, np.float32),
             "values": np.asarray(val_buf, np.float32),
             "last_values": np.asarray(last_value, np.float32),
+            "episode_returns": finished,
+        }
+
+    def sample_transitions(
+        self, params, num_steps: int, epsilon: float, model: str = "mlp_q"
+    ) -> Dict[str, Any]:
+        """Off-policy collection (DQN): epsilon-greedy over Q-values,
+        returns flat (s, a, r, s', done) transition arrays plus episode
+        stats. ``model``: "mlp_q" | "cnn_q"."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._apply_q is None:
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+            from ray_tpu.rl.models import apply_cnn_q, apply_mlp_q
+
+            self._apply_q = jax.jit(
+                apply_cnn_q if model == "cnn_q" else apply_mlp_q
+            )
+        obs_buf, act_buf, rew_buf, next_buf, done_buf = [], [], [], [], []
+        for _ in range(num_steps):
+            q = np.asarray(self._apply_q(params, jnp.asarray(self._obs)))
+            greedy = np.argmax(q, axis=-1)
+            explore = self._rng.random(self._num_envs) < epsilon
+            random_a = self._rng.integers(0, q.shape[-1], self._num_envs)
+            actions = np.where(explore, random_a, greedy)
+
+            next_obs, rewards, terminated, truncated, infos = self._envs.step(actions)
+            dones = np.logical_or(terminated, truncated)
+            # SAME_STEP autoreset returns the NEW episode's reset obs on
+            # done steps; the stored transition must end at the true
+            # final obs (a truncated episode bootstraps from it)
+            stored_next = next_obs
+            final = infos.get("final_obs", infos.get("final_observation"))
+            if final is not None and dones.any():
+                stored_next = np.array(next_obs)
+                for i in np.nonzero(dones)[0]:
+                    if final[i] is not None:
+                        stored_next[i] = final[i]
+            obs_buf.append(self._obs)
+            act_buf.append(actions)
+            rew_buf.append(rewards)
+            # bootstrap cuts only on TERMINATION — a truncated episode's
+            # final state still has value (standard DQN detail)
+            done_buf.append(terminated)
+            next_buf.append(stored_next)
+
+            self._episode_returns += rewards
+            for i, d in enumerate(dones):
+                if d:
+                    self._finished_returns.append(float(self._episode_returns[i]))
+                    self._episode_returns[i] = 0.0
+            self._obs = next_obs
+
+        finished, self._finished_returns = self._finished_returns, []
+        flat = lambda a: np.asarray(a).reshape(-1, *np.asarray(a).shape[2:])
+        return {
+            "obs": flat(obs_buf),
+            "actions": flat(act_buf).astype(np.int64),
+            "rewards": flat(rew_buf).astype(np.float32),
+            "next_obs": flat(next_buf),
+            "dones": flat(done_buf).astype(np.bool_),
             "episode_returns": finished,
         }
 

@@ -51,3 +51,123 @@ def apply_mlp_policy(params: Dict[str, Any], obs: jnp.ndarray) -> Tuple[jnp.ndar
     logits = x @ params["pi"]["w"] + params["pi"]["b"]
     value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
     return logits, value
+
+
+# ---------------------------------------------------------------------------
+# Q-networks (DQN) — MLP for vector obs, CNN for image obs
+
+
+def init_mlp_q(
+    rng: jax.Array,
+    obs_dim: int,
+    num_actions: int,
+    hidden: Sequence[int] = (128, 128),
+) -> Dict[str, Any]:
+    """MLP Q-network: obs -> Q(s, a) per action (reference
+    rllib/algorithms/dqn catalog, torch; pure-JAX here)."""
+    params: Dict[str, Any] = {"layers": [], "q": None}
+    sizes = [obs_dim, *hidden]
+    keys = jax.random.split(rng, len(hidden) + 1)
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        scale = math.sqrt(2.0 / fan_in)
+        params["layers"].append(
+            {
+                "w": jax.random.normal(keys[i], (fan_in, fan_out)) * scale,
+                "b": jnp.zeros((fan_out,)),
+            }
+        )
+    params["q"] = {
+        "w": jax.random.normal(keys[-1], (sizes[-1], num_actions)) * 0.01,
+        "b": jnp.zeros((num_actions,)),
+    }
+    return params
+
+
+def apply_mlp_q(params: Dict[str, Any], obs: jnp.ndarray) -> jnp.ndarray:
+    """obs [B, obs_dim] -> q-values [B, A]."""
+    x = obs.reshape(obs.shape[0], -1)
+    for layer in params["layers"]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    return x @ params["q"]["w"] + params["q"]["b"]
+
+
+#: Atari-style conv stack (reference rllib CNN defaults): NHWC input.
+DEFAULT_CONV = ((16, 8, 4), (32, 4, 2), (32, 3, 1))  # (channels, kernel, stride)
+
+
+def init_cnn(
+    rng: jax.Array,
+    obs_shape: Sequence[int],  # (H, W, C)
+    num_actions: int,
+    *,
+    conv: Sequence[Tuple[int, int, int]] = DEFAULT_CONV,
+    dense: int = 256,
+    heads: Sequence[str] = ("q",),
+) -> Dict[str, Any]:
+    """Conv torso + dense + one linear head per name in ``heads``
+    ("q" for DQN, "pi"+"vf" for actor-critic on images). Convs run as
+    ``lax.conv_general_dilated`` in NHWC — XLA lays them onto the MXU."""
+    if tuple(conv) != DEFAULT_CONV:
+        raise ValueError(
+            "custom conv stacks need their own apply fn: the stride "
+            "schedule is STATIC (a pytree-carried int would be traced "
+            "under jit) and the module-level apply_cnn_* assume "
+            "DEFAULT_CONV"
+        )
+    h, w, c = obs_shape
+    keys = jax.random.split(rng, len(conv) + 1 + len(heads))
+    params: Dict[str, Any] = {"conv": [], "dense": None}
+    in_ch = c
+    for i, (out_ch, k, s) in enumerate(conv):
+        scale = math.sqrt(2.0 / (k * k * in_ch))
+        params["conv"].append(
+            {
+                "w": jax.random.normal(keys[i], (k, k, in_ch, out_ch)) * scale,
+                "b": jnp.zeros((out_ch,)),
+            }
+        )
+        h = -(-h // s)  # ceil division (SAME padding)
+        w = -(-w // s)
+        in_ch = out_ch
+    flat = h * w * in_ch
+    params["dense"] = {
+        "w": jax.random.normal(keys[len(conv)], (flat, dense)) * math.sqrt(2.0 / flat),
+        "b": jnp.zeros((dense,)),
+    }
+    for j, head in enumerate(heads):
+        out = 1 if head == "vf" else num_actions
+        params[head] = {
+            "w": jax.random.normal(keys[len(conv) + 1 + j], (dense, out)) * 0.01,
+            "b": jnp.zeros((out,)),
+        }
+    return params
+
+
+def _cnn_torso(params: Dict[str, Any], obs: jnp.ndarray) -> jnp.ndarray:
+    x = obs  # already float32, normalized by the apply_* wrappers
+    for layer, (_ch, _k, stride) in zip(params["conv"], DEFAULT_CONV):
+        x = jax.lax.conv_general_dilated(
+            x,
+            layer["w"],
+            window_strides=(stride, stride),  # static (not pytree-carried)
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        x = jax.nn.relu(x + layer["b"])
+    x = x.reshape(x.shape[0], -1)
+    return jax.nn.relu(x @ params["dense"]["w"] + params["dense"]["b"])
+
+
+def apply_cnn_q(params: Dict[str, Any], obs: jnp.ndarray) -> jnp.ndarray:
+    """obs [B, H, W, C] (float or uint8) -> q-values [B, A]."""
+    x = _cnn_torso(params, obs.astype(jnp.float32) / 255.0 if obs.dtype == jnp.uint8 else obs)
+    return x @ params["q"]["w"] + params["q"]["b"]
+
+
+def apply_cnn_policy(params: Dict[str, Any], obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """obs [B, H, W, C] -> (logits [B, A], value [B]) — the image-obs
+    actor-critic head pair (PPO/IMPALA on pixels)."""
+    x = _cnn_torso(params, obs.astype(jnp.float32) / 255.0 if obs.dtype == jnp.uint8 else obs)
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
+    return logits, value

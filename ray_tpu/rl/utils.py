@@ -14,15 +14,29 @@ from ray_tpu.rl.env_runner import EnvRunner
 from ray_tpu.rl.models import apply_mlp_policy
 
 
-def probe_env_spec(env: str, env_config: Optional[Dict[str, Any]]) -> Tuple[int, int]:
-    """(obs_dim, num_actions) from one throwaway env instance."""
+def _make_probe_env(env: str, env_config: Optional[Dict[str, Any]]):
+    if ":" in env:
+        from ray_tpu.rl.env_runner import resolve_env_class
+
+        return resolve_env_class(env)(**(env_config or {}))
     import gymnasium as gym
 
-    probe = gym.make(env, **(env_config or {}))
-    obs_dim = int(np.prod(probe.observation_space.shape))
+    return gym.make(env, **(env_config or {}))
+
+
+def probe_env_spec(env: str, env_config: Optional[Dict[str, Any]]) -> Tuple[int, int]:
+    """(obs_dim, num_actions) from one throwaway env instance."""
+    shape, num_actions = probe_env_space(env, env_config)
+    return int(np.prod(shape)), num_actions
+
+
+def probe_env_space(env: str, env_config: Optional[Dict[str, Any]]) -> Tuple[tuple, int]:
+    """(obs_shape, num_actions) — shape preserved for image obs (CNN)."""
+    probe = _make_probe_env(env, env_config)
+    shape = tuple(probe.observation_space.shape)
     num_actions = int(probe.action_space.n)
     probe.close()
-    return obs_dim, num_actions
+    return shape, num_actions
 
 
 def make_runners(config) -> List[Any]:

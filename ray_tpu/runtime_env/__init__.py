@@ -95,10 +95,8 @@ class WorkingDirPlugin(RuntimeEnvPlugin):
     def apply(self, value: str, kv_get, *, permanent: bool):
         target = ensure_local(kv_get, value)
         sys.path.insert(0, target)
-        prev_cwd = None
         if permanent:
-            prev_cwd = os.getcwd()
-            os.chdir(target)
+            os.chdir(target)  # dedicated worker: cwd for its lifetime
             return None
 
         def restore():

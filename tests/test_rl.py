@@ -229,3 +229,111 @@ def test_impala_with_learner_gang(cluster):
         assert algo.compute_single_action(obs) == before
     finally:
         algo.stop()
+
+
+def test_replay_buffer_wraps_and_samples():
+    from ray_tpu.rl import ReplayBuffer
+
+    buf = ReplayBuffer(100, seed=0)
+    for start in range(0, 250, 50):
+        buf.add_batch({
+            "x": np.arange(start, start + 50, dtype=np.int64),
+            "y": np.ones((50, 2), np.float32),
+        })
+    assert len(buf) == 100
+    s = buf.sample(32)
+    assert s["x"].shape == (32,) and s["y"].shape == (32, 2)
+    # after wrapping, only the newest 100 values remain
+    assert s["x"].min() >= 150
+
+
+def test_dqn_learns_cartpole(cluster):
+    """DQN learning test (reference rllib learning-test pattern):
+    double-Q + replay must clearly improve the mean return."""
+    from ray_tpu.rl import DQNConfig
+
+    algo = DQNConfig(
+        num_env_runners=1,
+        num_envs_per_runner=4,
+        rollout_fragment_length=64,
+        lr=1e-3,
+        train_batch_size=64,
+        updates_per_iteration=48,
+        learning_starts=256,
+        target_update_freq=100,
+        epsilon_decay_steps=4000,
+        seed=7,
+    ).build()
+    try:
+        first = algo.train()["episode_return_mean"]
+        last = first
+        for _ in range(40):
+            out = algo.train()
+            last = out["episode_return_mean"]
+            if last >= 60.0:
+                break
+        assert last >= 60.0 or last >= 2.5 * max(first, 15.0), (first, last)
+    finally:
+        algo.stop()
+
+
+def test_dqn_state_roundtrip(cluster):
+    from ray_tpu.rl import DQNConfig
+
+    algo = DQNConfig(
+        num_env_runners=1, num_envs_per_runner=2,
+        rollout_fragment_length=16, learning_starts=16,
+        updates_per_iteration=4, seed=9,
+    ).build()
+    try:
+        algo.train()
+        state = algo.get_state()
+        obs = np.zeros(4, np.float32)
+        before = algo.compute_single_action(obs)
+        algo2 = DQNConfig(
+            num_env_runners=1, num_envs_per_runner=2,
+            rollout_fragment_length=16, seed=10,
+        ).build()
+        try:
+            algo2.set_state(state)
+            assert algo2.compute_single_action(obs) == before
+            assert algo2.gradient_steps == algo.gradient_steps
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
+
+
+def test_dqn_cnn_on_image_env(cluster):
+    """The image-obs path end to end: CNN Q-network + custom image env
+    resolved by module path on the runner workers (Atari stand-in)."""
+    from ray_tpu.rl import DQNConfig
+
+    algo = DQNConfig(
+        env="ray_tpu.rl.test_envs:TinyImageEnv",
+        model="cnn_q",
+        num_env_runners=1,
+        num_envs_per_runner=2,
+        rollout_fragment_length=32,
+        learning_starts=128,
+        train_batch_size=32,
+        updates_per_iteration=24,
+        lr=2e-3,
+        epsilon_decay_steps=1500,
+        target_update_freq=50,
+        seed=3,
+    ).build()
+    try:
+        first = algo.train()["episode_return_mean"]
+        last = first
+        for _ in range(50):
+            out = algo.train()
+            last = out["episode_return_mean"]
+            if last >= 12.5:  # optimal 16, random ~8
+                break
+        assert last >= 12.5, (first, last)
+        obs = np.zeros((8, 8, 3), np.uint8)
+        a = algo.compute_single_action(obs)
+        assert a in (0, 1)
+    finally:
+        algo.stop()
