@@ -91,6 +91,26 @@ def get(refs, *, timeout: Optional[float] = None):
 
     if isinstance(refs, CompiledDAGRef):
         return refs.get(timeout)
+    if isinstance(refs, list) and any(isinstance(r, CompiledDAGRef) for r in refs):
+        # Mixed list: the plain refs still fetch as ONE batched get (a
+        # per-element loop would serialize fetches and reapply the full
+        # timeout N times); compiled refs resolve via their channels
+        # against the same shared deadline.
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        plain = [r for r in refs if not isinstance(r, CompiledDAGRef)]
+        plain_values = iter(
+            _api._global_worker().get(plain, timeout=timeout) if plain else []
+        )
+        out = []
+        for r in refs:
+            if isinstance(r, CompiledDAGRef):
+                left = None if deadline is None else max(0.0, deadline - _time.monotonic())
+                out.append(r.get(left))
+            else:
+                out.append(next(plain_values))
+        return out
     return _api._global_worker().get(refs, timeout=timeout)
 
 

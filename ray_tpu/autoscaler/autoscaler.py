@@ -112,7 +112,29 @@ class StandardAutoscaler:
         counts: Dict[str, int] = {}
         for grp in groups.values():
             counts[grp[0]["node_type"]] = counts.get(grp[0]["node_type"], 0) + 1
+        # Booting supply credit (reference resource_demand_scheduler's
+        # "upcoming nodes"): provider nodes not yet in the controller
+        # snapshot are capacity in flight — without seeding them here,
+        # every reconcile pass during a node's boot re-launches for the
+        # SAME unmet demand until the max_workers caps bite. Only nodes
+        # that NEVER joined count (a snapshot row — alive or dead —
+        # means joined; dead ones are losses, not boot-pending), and
+        # the credit expires after boot_grace_s so a hung launch stops
+        # suppressing replacements.
+        known_ids = {n["node_id"] for n in snap["nodes"]}
+        types_by_name = {t.name: t for t in self._config.node_types}
+        now_wall = time.time()
         virtual: List[Dict[str, float]] = []
+        for rec in provider_nodes:
+            nid = rec.get("node_id_hex")
+            if nid is not None and nid in known_ids:
+                continue
+            launched = rec.get("launched_at")
+            if launched is not None and now_wall - launched > self._config.boot_grace_s:
+                continue  # boot presumed failed
+            nt = types_by_name.get(rec.get("node_type"))
+            if nt is not None:
+                virtual.append(dict(nt.resources))
         for shape in unmet:
             placed = False
             for cap in virtual:

@@ -33,3 +33,7 @@ class AutoscalerConfig:
     update_interval_s: float = 1.0
     #: cluster-wide cap on provider-launched nodes
     max_workers: int = 8
+    #: how long a launched node gets credited as booting supply before
+    #: it's treated as failed (stops double-launching during boot
+    #: without trusting a hung/dead launch forever)
+    boot_grace_s: float = 120.0

@@ -40,6 +40,11 @@ REQUEST, REPLY_OK, REPLY_ERR, PUSH = 0, 1, 2, 3
 MAX_FRAME = 1 << 31
 
 
+#: corked writes flush early past this many buffered bytes (keeps
+#: drain()'s flow-control view at most one small flush stale)
+_FLUSH_BYTES = 1 << 20
+
+
 class RpcError(Exception):
     pass
 
@@ -189,8 +194,15 @@ class ServerConnection:
     async def send(self, kind: int, seq: int, method: bytes, payload: bytes) -> None:
         if self._closed:
             raise ConnectionLost("connection closed")
-        self._out.append(_encode_frame(kind, seq, method, payload))
-        if not self._flush_scheduled:
+        frame = _encode_frame(kind, seq, method, payload)
+        self._out.append(frame)
+        self._out_bytes = getattr(self, "_out_bytes", 0) + len(frame)
+        if self._out_bytes >= _FLUSH_BYTES:
+            # large buffers flush NOW: the cork trades one loop tick of
+            # latency for syscall coalescing, but drain()'s flow control
+            # only sees written bytes — an unbounded cork defeats it
+            self._flush()
+        elif not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_event_loop().call_soon(self._flush)
         await self.writer.drain()
@@ -201,10 +213,13 @@ class ServerConnection:
             self._out.clear()
             return
         frames, self._out = self._out, []
+        self._out_bytes = 0
         try:
             self.writer.write(b"".join(frames) if len(frames) > 1 else frames[0])
         except Exception:
-            pass  # reader loop notices the dead connection
+            # mark closed so subsequent sends fail fast instead of
+            # buffering into a dead socket until the reader notices
+            self._closed = True
 
     async def push(self, channel: int, payload: Any) -> None:
         """Server-initiated message on a subscription channel."""
@@ -329,10 +344,14 @@ class RpcClient:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[seq] = fut
         try:
-            self._out.append(
-                _encode_frame(REQUEST, seq, method.encode(), pickle.dumps(payload, protocol=5))
+            frame = _encode_frame(
+                REQUEST, seq, method.encode(), pickle.dumps(payload, protocol=5)
             )
-            if not self._flush_scheduled:
+            self._out.append(frame)
+            self._out_bytes = getattr(self, "_out_bytes", 0) + len(frame)
+            if self._out_bytes >= _FLUSH_BYTES:
+                self._flush()  # see ServerConnection.send: bound the cork
+            elif not self._flush_scheduled:
                 self._flush_scheduled = True
                 asyncio.get_event_loop().call_soon(self._flush)
             await self._writer.drain()
@@ -348,12 +367,25 @@ class RpcClient:
         writer = self._writer
         if not self._out or writer is None:
             self._out.clear()
+            self._out_bytes = 0
             return
         frames, self._out = self._out, []
+        self._out_bytes = 0
         try:
             writer.write(b"".join(frames) if len(frames) > 1 else frames[0])
         except Exception:
-            pass  # read loop fails the pending futures on disconnect
+            # fail in-flight calls NOW — waiting for the read loop to
+            # notice the dead socket can add a full timeout of latency
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost(f"write to {self.name} failed"))
+            self._pending.clear()
+            try:
+                writer.close()
+            except Exception:
+                pass
+            if self._writer is writer:
+                self._writer = None
 
     async def close(self) -> None:
         self._closed = True

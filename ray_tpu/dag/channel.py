@@ -210,7 +210,15 @@ class ShmChannel:
         body_off = off + _SLOT_HDR.size
         self._buf[body_off] = kind
         self._buf[body_off + 1 : body_off + 1 + len(payload)] = payload
-        # length then version: version is the release fence readers check
+        # Length then version: the version word is what readers poll.
+        # ORDERING CAVEAT: these are plain memoryview stores with no
+        # explicit release fence — correctness relies on x86-TSO (stores
+        # retire in program order). On a weakly-ordered host (ARM) a
+        # reader could observe version==seq+1 before the payload stores
+        # and deserialize torn data; porting there needs an atomic
+        # release write (or a payload checksum in the slot header).
+        # TPU-host fleets are x86, so this build documents rather than
+        # pays the fence cost.
         _SLOT_HDR.pack_into(self._buf, off, 0, len(payload) + 1)
         _U64.pack_into(self._buf, off, seq + 1)
         for sem in self._reader_sems:

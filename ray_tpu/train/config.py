@@ -108,3 +108,6 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    #: LoggerCallback instances (reference ``RunConfig.callbacks`` —
+    #: CSV/JSON/TensorBoard in ``ray_tpu.tune.loggers``)
+    callbacks: list = field(default_factory=list)

@@ -3,12 +3,24 @@
 Reference: ``python/ray/tune/`` (Tuner/TuneController, basic-variant
 search, ASHA). See ``tuner.py`` for the controller design."""
 
+from ray_tpu.tune.loggers import (
+    CSVLoggerCallback,
+    JSONLoggerCallback,
+    LoggerCallback,
+    TensorBoardLoggerCallback,
+)
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
+    ConcurrencyLimiter,
+    OptunaSearch,
+    RandomSearch,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -21,8 +33,18 @@ from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner
 
 __all__ = [
     "ASHAScheduler",
+    "CSVLoggerCallback",
+    "ConcurrencyLimiter",
     "FIFOScheduler",
+    "JSONLoggerCallback",
+    "LoggerCallback",
+    "MedianStoppingRule",
+    "OptunaSearch",
     "PopulationBasedTraining",
+    "RandomSearch",
+    "Searcher",
+    "TPESearcher",
+    "TensorBoardLoggerCallback",
     "get_checkpoint",
     "ResultGrid",
     "Trial",

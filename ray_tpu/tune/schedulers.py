@@ -156,3 +156,48 @@ class PopulationBasedTraining:
 
     def on_trial_complete(self, trial_id: str) -> None:
         self._complete.add(trial_id)
+
+
+class MedianStoppingRule:
+    """Median stopping (reference
+    ``tune/schedulers/median_stopping_rule.py:19``): stop a trial at
+    iteration t if its best metric so far is worse than the MEDIAN of
+    the other trials' running averages at comparable progress."""
+
+    def __init__(
+        self,
+        *,
+        metric: str | None = None,
+        mode: str | None = None,
+        grace_period: int = 4,
+        min_samples_required: int = 3,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        #: trial_id -> list of signed metric values per report
+        self._histories: Dict[str, List[float]] = {}
+
+    def on_result(self, trial_id: str, iteration: int, metric_value: float) -> str:
+        v = -metric_value if self.mode == "min" else metric_value
+        hist = self._histories.setdefault(trial_id, [])
+        hist.append(v)
+        if iteration < self.grace_period:
+            return CONTINUE
+        # running averages of OTHER trials truncated to this progress
+        others = [
+            sum(h[:iteration]) / min(len(h), iteration)
+            for t, h in self._histories.items()
+            if t != trial_id and h
+        ]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        if max(hist) < median:
+            return STOP
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        pass

@@ -39,7 +39,11 @@ class TuneConfig:
     mode: str = "max"
     num_samples: int = 1
     max_concurrent_trials: int = 4
-    scheduler: Any = None  # FIFOScheduler | ASHAScheduler
+    scheduler: Any = None  # FIFOScheduler | ASHAScheduler | PBT | MedianStopping
+    #: a Searcher (TPESearcher/OptunaSearch/RandomSearch, optionally
+    #: wrapped in ConcurrencyLimiter): trial configs come from
+    #: suggest() sequentially instead of up-front variant expansion
+    search_alg: Any = None
     seed: Optional[int] = None
 
 
@@ -231,6 +235,31 @@ class Tuner:
         if getattr(scheduler, "mode", "x") is None:
             scheduler.mode = cfg.mode
         metric = getattr(scheduler, "metric", None) or cfg.metric
+        search = cfg.search_alg
+        if search is not None:
+            search.set_search_properties(cfg.metric, cfg.mode, self.param_space)
+        callbacks = list(getattr(self.run_config, "callbacks", None) or [])
+        exp_dir = None
+        rc = self.run_config
+        if rc is not None and getattr(rc, "storage_path", None):
+            exp_dir = os.path.join(
+                rc.storage_path, getattr(rc, "name", None) or "tune_experiment"
+            )
+        for cb in callbacks:
+            setup = getattr(cb, "setup", None)
+            if setup is not None:
+                setup(exp_dir)
+
+        def notify_complete(t: Trial) -> None:
+            scheduler.on_trial_complete(t.trial_id)
+            if search is not None:
+                search.on_trial_complete(t.trial_id, t.last_metrics or {})
+            for cb in callbacks:
+                try:
+                    cb.on_trial_complete(t)
+                except Exception:
+                    pass
+
         if self._restored_trials is not None:
             trials = self._restored_trials
             # unfinished trials restart (from their latest checkpoint)
@@ -240,6 +269,11 @@ class Tuner:
                     t.status = PENDING
                     t.actor = None
                     pending.append(t)
+        elif search is not None:
+            # sequential suggestion: trials materialize as slots open so
+            # the searcher can condition on completed results
+            trials = []
+            pending = []
         else:
             variants = generate_variants(
                 self.param_space, num_samples=cfg.num_samples, seed=cfg.seed
@@ -249,6 +283,18 @@ class Tuner:
                 for i, v in enumerate(variants)
             ]
             pending = list(trials)
+        to_suggest = 0
+        if search is not None:
+            if self._restored_trials is None:
+                to_suggest = cfg.num_samples
+            else:
+                # resume: replay completed trials into the searcher so
+                # its model warm-starts, then suggest the REMAINING
+                # budget (not zero — that would silently truncate)
+                for t in trials:
+                    if t.status in (TERMINATED, STOPPED, ERRORED) and t.last_metrics:
+                        search.on_trial_complete(t.trial_id, t.last_metrics)
+                to_suggest = max(0, cfg.num_samples - len(trials))
         trials_by_id = {t.trial_id: t for t in trials}
         launching: List[tuple] = []  # (trial, run_ref): actor may be queued
         running: List[Trial] = []
@@ -273,13 +319,26 @@ class Tuner:
                 )
             )
 
-        while pending or launching or running:
+        while pending or launching or running or to_suggest > 0:
             now = time.monotonic()
             if now - last_snapshot > 2.0:
                 last_snapshot = now
                 self._save_snapshot(trials)
             while pending and len(launching) + len(running) < cfg.max_concurrent_trials:
                 launch(pending.pop(0))
+            while (
+                to_suggest > 0
+                and len(launching) + len(running) < cfg.max_concurrent_trials
+            ):
+                tid = f"trial_{len(trials):04d}_{uuid.uuid4().hex[:6]}"
+                config = search.suggest(tid)
+                if config is None:
+                    break  # ConcurrencyLimiter: wait for a completion
+                t = Trial(trial_id=tid, config=config)
+                trials.append(t)
+                trials_by_id[tid] = t
+                to_suggest -= 1
+                launch(t)
 
             still_launching: List[tuple] = []
             for t, run_ref in launching:
@@ -294,7 +353,7 @@ class Tuner:
                 except Exception as e:  # noqa: BLE001
                     t.status = ERRORED
                     t.error = f"trial actor failed to start: {e!r}"
-                    scheduler.on_trial_complete(t.trial_id)
+                    notify_complete(t)
                     try:
                         ray_tpu.kill(t.actor)  # release its reservation
                     except Exception:
@@ -310,7 +369,7 @@ class Tuner:
                 except Exception as e:  # noqa: BLE001
                     t.status = ERRORED
                     t.error = f"trial actor died: {e!r}"
-                    scheduler.on_trial_complete(t.trial_id)
+                    notify_complete(t)
                     continue
                 stop = False
                 exploit_src: Optional[str] = None
@@ -324,6 +383,11 @@ class Tuner:
                     t.metrics_history.append(report)
                     if ck is not None:
                         t.last_checkpoint = ck
+                    for cb in callbacks:
+                        try:
+                            cb.on_trial_result(t, report)
+                        except Exception:
+                            pass
                     value = report.get(metric) if metric else None
                     if value is not None and not stop and exploit_src is None:
                         decision = scheduler.on_result(
@@ -352,16 +416,16 @@ class Tuner:
                     continue
                 if stop:
                     t.status = STOPPED
-                    scheduler.on_trial_complete(t.trial_id)
+                    notify_complete(t)
                     ray_tpu.kill(t.actor)
                 elif poll["error"] is not None and not poll["reports"]:
                     t.status = ERRORED
                     t.error = poll["error"]
-                    scheduler.on_trial_complete(t.trial_id)
+                    notify_complete(t)
                     ray_tpu.kill(t.actor)
                 elif poll["done"] and not poll["reports"]:
                     t.status = TERMINATED
-                    scheduler.on_trial_complete(t.trial_id)
+                    notify_complete(t)
                     ray_tpu.kill(t.actor)
                 else:
                     still_running.append(t)

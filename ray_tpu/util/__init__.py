@@ -1,5 +1,6 @@
 """Utility APIs (reference ``ray.util``)."""
 
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
     PlacementGroup,
     get_placement_group,
@@ -15,6 +16,7 @@ from ray_tpu.util.scheduling_strategies import (
 from ray_tpu.util import state
 
 __all__ = [
+    "ActorPool",
     "PlacementGroup",
     "placement_group",
     "remove_placement_group",

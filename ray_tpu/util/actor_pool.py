@@ -1,0 +1,135 @@
+"""ActorPool: load-balanced work submission over a fixed actor set.
+
+Reference: ``python/ray/util/actor_pool.py:13`` — same surface:
+``submit``, ``get_next`` / ``get_next_unordered``, ``map`` /
+``map_unordered``, ``has_next``, ``push``/``pop_idle``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        #: ref -> (actor, submit order index)
+        self._inflight: dict = {}
+        self._index_to_ref: dict = {}
+        self._next_submit = 0
+        self._next_return = 0
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """``fn(actor, value) -> ObjectRef``, e.g.
+        ``pool.submit(lambda a, v: a.work.remote(v), item)``."""
+        if not self._idle:
+            raise ValueError("no idle actors — call get_next* first")
+        actor = self._idle.pop(0)
+        ref = fn(actor, value)
+        self._inflight[ref] = (actor, self._next_submit)
+        self._index_to_ref[self._next_submit] = ref
+        self._next_submit += 1
+
+    def has_next(self) -> bool:
+        return bool(self._inflight)
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def get_next(self, timeout: float | None = None) -> Any:
+        """Next result in SUBMISSION order. On timeout the task stays
+        pending (retry later); the actor re-idles only once its result
+        (or task error) is actually consumed — a timed-out task's actor
+        is still busy and must not be double-booked."""
+        from ray_tpu.core.exceptions import GetTimeoutError
+
+        # skip indices consumed out-of-order by get_next_unordered
+        while (
+            self._next_return not in self._index_to_ref
+            and self._next_return < self._next_submit
+        ):
+            self._next_return += 1
+        if self._next_return not in self._index_to_ref:
+            raise StopIteration("no pending result")
+        ref = self._index_to_ref[self._next_return]
+        try:
+            result = ray_tpu.get(ref, timeout=timeout)
+        except GetTimeoutError:
+            raise  # state untouched: caller can retry
+        except Exception:
+            self._consume(ref, self._next_return)
+            raise  # task error = delivered result
+        self._consume(ref, self._next_return)
+        return result
+
+    def _consume(self, ref, idx: int) -> None:
+        self._index_to_ref.pop(idx, None)
+        actor, _ = self._inflight.pop(ref)
+        self._next_return = idx + 1
+        self._idle.append(actor)
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        """Whichever pending result finishes first."""
+        from ray_tpu.core.exceptions import GetTimeoutError
+
+        if not self._inflight:
+            raise StopIteration("no pending result")
+        done, _ = ray_tpu.wait(
+            list(self._inflight), num_returns=1, timeout=timeout
+        )
+        if not done:
+            raise TimeoutError("no result ready in time")
+        ref = done[0]
+        _actor, idx = self._inflight[ref]
+        try:
+            result = ray_tpu.get(ref, timeout=timeout)
+        except GetTimeoutError:
+            raise
+        except Exception:
+            self._consume_unordered(ref, idx)
+            raise
+        self._consume_unordered(ref, idx)
+        return result
+
+    def _consume_unordered(self, ref, idx: int) -> None:
+        actor, _ = self._inflight.pop(ref)
+        self._index_to_ref.pop(idx, None)
+        self._idle.append(actor)
+
+    def map(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
+        values = list(values)
+        sent = 0
+        for v in values:
+            if not self.has_free():
+                break
+            self.submit(fn, v)
+            sent += 1
+        for v in values[sent:]:
+            yield self.get_next()
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]) -> Iterator[Any]:
+        values = list(values)
+        sent = 0
+        for v in values:
+            if not self.has_free():
+                break
+            self.submit(fn, v)
+            sent += 1
+        for v in values[sent:]:
+            yield self.get_next_unordered()
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def push(self, actor: Any) -> None:
+        self._idle.append(actor)
+
+    def pop_idle(self) -> Any:
+        if not self._idle:
+            raise ValueError("no idle actors")
+        return self._idle.pop()

@@ -1,0 +1,67 @@
+"""Ecosystem shims: ActorPool, util.Queue, multiprocessing Pool
+(reference ``util/actor_pool.py:13``, ``util/queue.py``,
+``util/multiprocessing/pool.py``)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Queue
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_actor_pool_ordered_and_unordered(cluster):
+    @ray_tpu.remote(num_cpus=0.5)
+    class W:
+        def work(self, x):
+            import time
+
+            time.sleep(0.01 * (x % 3))
+            return x * 2
+
+    pool = ActorPool([W.remote() for _ in range(2)])
+    assert list(pool.map(lambda a, v: a.work.remote(v), range(8))) == [
+        v * 2 for v in range(8)
+    ]
+    out = sorted(pool.map_unordered(lambda a, v: a.work.remote(v), range(8)))
+    assert out == [v * 2 for v in range(8)]
+
+
+def test_queue_fifo_across_workers(cluster):
+    q = Queue(maxsize=4)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get(timeout=10) == "a"
+
+    @ray_tpu.remote(num_cpus=0.5)
+    def producer(q):
+        for i in range(3):
+            q.put(i)
+        return True
+
+    assert ray_tpu.get(producer.remote(q), timeout=60)
+    got = [q.get(timeout=10) for _ in range(4)]
+    assert got == ["b", 0, 1, 2]
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_multiprocessing_pool(cluster):
+    def square(x):
+        return x * x
+
+    with Pool(2, ray_remote_args={"num_cpus": 0.5}) as p:
+        assert p.map(square, range(10)) == [x * x for x in range(10)]
+        assert sorted(p.imap_unordered(square, range(5))) == [0, 1, 4, 9, 16]
+        ar = p.apply_async(square, (7,))
+        assert ar.get(timeout=60) == 49
+        assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
