@@ -68,7 +68,11 @@ class FakeMultiNodeProvider(NodeProvider):
                     # the unit of accounting AND termination
                     "group": group,
                     "node_type": node_type.name,
-                    "launched_at": time.monotonic(),
+                    # wall clock: consumed by the autoscaler's boot-grace
+                # check, which also uses time.time() — a monotonic stamp
+                # compared against wall time would make every boot look
+                # ancient and void the booting-supply credit
+                "launched_at": time.time(),
                     "proc": proc,
                     "node_id_hex": getattr(proc, "node_id_hex", None),
                 }

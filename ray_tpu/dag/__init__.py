@@ -12,6 +12,7 @@ Usage::
 """
 
 from ray_tpu.dag.channel import ChannelClosedError, ChannelTimeoutError, ShmChannel
+from ray_tpu.dag.collective import CollectiveOutputNode, allreduce
 from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef
 from ray_tpu.dag.node import (
     ActorClassNode,
@@ -26,6 +27,8 @@ from ray_tpu.dag.node import (
 __all__ = [
     "ActorClassNode",
     "ActorMethodNode",
+    "CollectiveOutputNode",
+    "allreduce",
     "ChannelClosedError",
     "ChannelTimeoutError",
     "CompiledDAG",

@@ -1,0 +1,68 @@
+"""DAG collective nodes: allreduce across compiled-graph actors.
+
+Reference: ``python/ray/dag/collective_node.py:127`` +
+``experimental/collective/allreduce.py`` — N upstream nodes (one per
+actor) feed one logical collective; every actor receives the reduced
+value locally. The reference transports over NCCL; the TPU-native
+backend is the object-store relay group (``parallel/collectives.py``) —
+cross-PROCESS dense reduction on TPU hosts rides DCN/shm, while
+intra-program reductions belong to XLA collectives (``parallel/``),
+not the DAG layer.
+
+    with InputNode() as inp:
+        s1 = a1.shard.bind(inp)
+        s2 = a2.shard.bind(inp)
+        r1, r2 = allreduce.bind([s1, s2], op="sum")
+        dag = MultiOutputNode([r1, r2])
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import List
+
+from ray_tpu.dag.node import ActorMethodNode, DAGNode
+
+
+class CollectiveOutputNode(DAGNode):
+    """Rank ``rank``'s output of one logical allreduce."""
+
+    def __init__(self, group_uid: str, upstream: ActorMethodNode, op: str,
+                 world_size: int, rank: int):
+        self.group_uid = group_uid
+        self.upstream = upstream
+        self.op = op
+        self.world_size = world_size
+        self.rank = rank
+        # the collective executes IN the upstream node's actor
+        self.handle = upstream.handle
+
+    def _upstream(self) -> List[DAGNode]:
+        return [self.upstream]
+
+
+class _AllReduce:
+    def bind(self, nodes: List[ActorMethodNode], op: str = "sum") -> List[CollectiveOutputNode]:
+        if len(nodes) < 2:
+            raise ValueError("allreduce needs >=2 participating nodes")
+        actors = set()
+        for n in nodes:
+            if not isinstance(n, ActorMethodNode):
+                raise TypeError(
+                    "allreduce participants must be actor-method nodes"
+                )
+            aid = n.handle.actor_id.binary()
+            if aid in actors:
+                raise ValueError(
+                    "allreduce participants must live on DISTINCT actors "
+                    "(one rank per process)"
+                )
+            actors.add(aid)
+        uid = uuid.uuid4().hex[:12]
+        return [
+            CollectiveOutputNode(uid, n, op, len(nodes), i)
+            for i, n in enumerate(nodes)
+        ]
+
+
+allreduce = _AllReduce()

@@ -34,6 +34,7 @@ from ray_tpu.dag.channel import (
     ChannelClosedError,
     ShmChannel,
 )
+from ray_tpu.dag.collective import CollectiveOutputNode
 from ray_tpu.dag.node import (
     ActorMethodNode,
     DAGNode,
@@ -187,7 +188,7 @@ class CompiledDAG:
                     "compiled graphs support actor methods only; "
                     "fn.bind(...) nodes require classic execute()"
                 )
-            if not isinstance(node, ActorMethodNode):
+            if not isinstance(node, (ActorMethodNode, CollectiveOutputNode)):
                 raise TypeError(f"cannot compile node type {type(node).__name__}")
             for up in node._upstream():
                 visit(up)
@@ -212,20 +213,54 @@ class CompiledDAG:
         chan_of: Dict[int, _ChannelSpec] = {}  # producing node id -> channel
         n_chan = 0
 
-        def actor_of(node: ActorMethodNode):
+        def actor_of(node):
             return node.handle.actor_id.binary()
 
         # a node needs a channel iff some consumer lives in another process
         consumers: Dict[int, List[Any]] = {id(n): [] for n in order}
         for node in order:
             for up in node._upstream():
-                if isinstance(up, ActorMethodNode):
+                if isinstance(up, (ActorMethodNode, CollectiveOutputNode)):
                     consumers[id(up)].append(actor_of(node))
                 elif isinstance(up, (InputNode, InputAttributeNode)):
                     if actor_of(node) not in self._input_chan_spec.readers:
                         self._input_chan_spec.readers.append(actor_of(node))
         for out in outputs:
             consumers[id(out)].append("driver")
+
+        # every rank of an allreduce must be reachable from the outputs:
+        # a missing rank's actor never runs its collective op and the
+        # present ranks HANG in the rendezvous (reference raises too)
+        ranks_present: Dict[str, int] = {}
+        world_of: Dict[str, int] = {}
+        for node in order:
+            if isinstance(node, CollectiveOutputNode):
+                ranks_present[node.group_uid] = ranks_present.get(node.group_uid, 0) + 1
+                world_of[node.group_uid] = node.world_size
+        for uid, present in ranks_present.items():
+            if present != world_of[uid]:
+                raise ValueError(
+                    f"allreduce group {uid}: only {present}/{world_of[uid]} "
+                    "ranks are reachable from the DAG outputs — consume "
+                    "every CollectiveOutputNode (unreferenced ranks would "
+                    "deadlock the rendezvous)"
+                )
+
+        # tensor-transport contract: a "device" producer must never need
+        # a cross-process channel (TPU has no device IPC; see
+        # DAGNode.with_tensor_transport)
+        for node in order:
+            if getattr(node, "transport", "auto") == "device":
+                remote = [c for c in consumers[id(node)] if c != actor_of(node)]
+                if remote:
+                    raise ValueError(
+                        f"node {getattr(node, 'method_name', node)!r} is "
+                        "annotated with_tensor_transport('device') but has "
+                        "consumers in other processes — TPU device buffers "
+                        "cannot cross processes; keep the pipeline stage on "
+                        "one actor or use XLA collectives (parallel/) for "
+                        "cross-chip movement"
+                    )
 
         for node in order:
             remote = [c for c in consumers[id(node)] if c != actor_of(node)]
@@ -254,7 +289,7 @@ class CompiledDAG:
                     plan["chans"][spec.name] = d
                     key = a.key if isinstance(a, InputAttributeNode) else None
                     return ("chan", spec.name, key)
-                if isinstance(a, ActorMethodNode):
+                if isinstance(a, (ActorMethodNode, CollectiveOutputNode)):
                     if actor_of(a) == aid:
                         return ("local", local_ids[id(a)])
                     spec = chan_of[id(a)]
@@ -267,15 +302,32 @@ class CompiledDAG:
                 return ("const", pickle.dumps(a))
 
             out_spec = chan_of.get(id(node))
-            plan["ops"].append(
-                {
-                    "method": node.method_name,
-                    "args": [argspec(a) for a in node.args],
-                    "kwargs": {k: argspec(v) for k, v in node.kwargs.items()},
-                    "local_id": local_ids[id(node)],
-                    "out": out_spec.as_dict() if out_spec else None,
-                }
-            )
+            if isinstance(node, CollectiveOutputNode):
+                plan["ops"].append(
+                    {
+                        "method": None,
+                        "collective": {
+                            "group": f"dag-{run_id}-{node.group_uid}",
+                            "world": node.world_size,
+                            "rank": node.rank,
+                            "op": node.op,
+                        },
+                        "args": [argspec(node.upstream)],
+                        "kwargs": {},
+                        "local_id": local_ids[id(node)],
+                        "out": out_spec.as_dict() if out_spec else None,
+                    }
+                )
+            else:
+                plan["ops"].append(
+                    {
+                        "method": node.method_name,
+                        "args": [argspec(a) for a in node.args],
+                        "kwargs": {k: argspec(v) for k, v in node.kwargs.items()},
+                        "local_id": local_ids[id(node)],
+                        "out": out_spec.as_dict() if out_spec else None,
+                    }
+                )
 
         # driver-side channel objects (create them all here — actors attach)
         self._input_chan = ShmChannel(
@@ -477,6 +529,7 @@ def run_dag_loop(actor_instance, plan: Dict[str, Any]) -> None:
         if op["out"] is not None and op["out"]["name"] not in out_chans:
             out_chans[op["out"]["name"]] = ShmChannel(op["out"]["name"])
     consts: Dict[int, Any] = {}
+    coll_groups: Dict[str, Any] = {}  # lazy per-loop collective groups
 
     from ray_tpu.core import serialization
 
@@ -541,7 +594,20 @@ def run_dag_loop(actor_instance, plan: Dict[str, Any]) -> None:
                         raise error
                     args = [resolve(s) for s in op["args"]]
                     kwargs = {k: resolve(s) for k, s in op["kwargs"].items()}
-                    result = getattr(actor_instance, op["method"])(*args, **kwargs)
+                    coll = op.get("collective")
+                    if coll is not None:
+                        # DAG allreduce (reference collective_node.py:127)
+                        # over the object-store relay group
+                        group = coll_groups.get(coll["group"])
+                        if group is None:
+                            from ray_tpu.parallel.collectives import CollectiveGroup
+
+                            group = coll_groups[coll["group"]] = CollectiveGroup(
+                                coll["group"], coll["world"], coll["rank"]
+                            )
+                        result = group.allreduce(args[0], op=coll["op"])
+                    else:
+                        result = getattr(actor_instance, op["method"])(*args, **kwargs)
                     local_vals[op["local_id"]] = result
                     if op["out"] is not None:
                         ch = out_chans[op["out"]["name"]]

@@ -15,6 +15,25 @@ from typing import Any, Dict, List, Optional, Tuple
 class DAGNode:
     """Base: a lazily-bound call in the graph."""
 
+    def with_tensor_transport(self, transport: str = "auto") -> "DAGNode":
+        """Annotate how this node's output tensors move to consumers
+        (reference ``with_tensor_transport``/``with_type_hint``):
+
+        - ``"auto"`` (default): same-actor consumers get the value by
+          reference (zero copies); cross-process consumers get shm.
+        - ``"device"``: REQUIRE the value to stay on-device — compile
+          fails if any consumer lives in another process, because TPU
+          has no cross-process device IPC (one process owns a chip;
+          the CUDA-IPC/NCCL channel of the reference has no TPU
+          analogue — cross-chip movement belongs to XLA collectives
+          inside one program, see parallel/).
+        - ``"shm"``: always stage through the shm channel.
+        """
+        if transport not in ("auto", "device", "shm"):
+            raise ValueError(f"unknown tensor transport {transport!r}")
+        self.transport = transport
+        return self
+
     def execute(self, *args, **kwargs):
         """Classic execution: walk the DAG, one ``.remote()`` per node,
         returning an ObjectRef (or list for MultiOutputNode)."""

@@ -185,3 +185,84 @@ class TestCompiled:
                 compiled.execute(np.zeros(1 << 20, dtype=np.uint8))
         finally:
             compiled.teardown()
+
+
+def test_dag_allreduce_collective_node(cluster):
+    """DAG allreduce (reference dag/collective_node.py:127): each
+    participating actor contributes its shard and receives the reduced
+    value locally, every execution."""
+    import numpy as np
+
+    from ray_tpu.dag import InputNode, MultiOutputNode
+    from ray_tpu.dag.collective import allreduce
+
+    @ray_tpu.remote(num_cpus=0.5)
+    class Shard:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def compute(self, x):
+            return np.asarray(x, np.float64) * self.scale
+
+        def label(self, reduced):
+            return float(np.sum(reduced))
+
+    a1, a2 = Shard.remote(1.0), Shard.remote(10.0)
+    with InputNode() as inp:
+        s1 = a1.compute.bind(inp)
+        s2 = a2.compute.bind(inp)
+        r1, r2 = allreduce.bind([s1, s2], op="sum")
+        dag = MultiOutputNode([a1.label.bind(r1), a2.label.bind(r2)])
+    compiled = dag.experimental_compile()
+    try:
+        for k in (1, 2, 3):
+            x = np.full(4, float(k))
+            out = ray_tpu.get(compiled.execute(x), timeout=60)
+            # each actor sees sum of both shards: k*(1+10) per element * 4
+            assert out == [44.0 * k, 44.0 * k], out
+    finally:
+        compiled.teardown()
+
+
+def test_dag_device_transport_contract(cluster):
+    """with_tensor_transport('device'): same-actor chains compile (the
+    value passes by reference, zero copies); a cross-process consumer is
+    rejected at compile time (TPU has no device IPC)."""
+    import numpy as np
+
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote(num_cpus=0.5)
+    class Stage:
+        def load(self, x):
+            import jax.numpy as jnp
+
+            return jnp.asarray(np.asarray(x, np.float32)) * 2
+
+        def reduce(self, arr):
+            # consumes the device array produced by load IN-PROCESS:
+            # with a transfer guard, any host round-trip would raise
+            import jax
+
+            with jax.transfer_guard_device_to_host("disallow"):
+                doubled = arr + 1
+            return float(doubled.sum())
+
+    s = Stage.remote()
+    with InputNode() as inp:
+        loaded = s.load.bind(inp).with_tensor_transport("device")
+        dag = s.reduce.bind(loaded)
+    compiled = dag.experimental_compile()
+    try:
+        out = ray_tpu.get(compiled.execute(np.ones(8)), timeout=60)
+        assert out == (2.0 + 1.0) * 8
+    finally:
+        compiled.teardown()
+
+    # cross-process consumer of a device-annotated node must be rejected
+    s2 = Stage.remote()
+    with InputNode() as inp:
+        loaded = s.load.bind(inp).with_tensor_transport("device")
+        bad = s2.reduce.bind(loaded)
+    with pytest.raises(ValueError, match="device"):
+        bad.experimental_compile()

@@ -92,3 +92,48 @@ def test_psum_grad_allreduce():
     g = jax.jit(jax.grad(loss))(w, xs)  # GSPMD inserts the all-reduce
     g_ref = jax.grad(loss)(w, x)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-4, atol=1e-5)
+
+
+def test_slice_topology_mesh_two_slice_train_step():
+    """Multi-slice (DCN) path: a 2-slice mesh (data spans slices,
+    fsdp/tensor inside each slice) compiles and executes a full sharded
+    train step — the VERDICT-flagged untested path (SURVEY §5.8(b))."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import (
+        LlamaConfig,
+        batch_sharding,
+        init_sharded,
+        make_train_step,
+    )
+    from ray_tpu.parallel.mesh import MeshSpec, slice_topology_mesh
+    from ray_tpu.parallel.sharding import tp_rules
+
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest forces an 8-device CPU mesh"
+    # 2 slices x (fsdp=2, tensor=2) per slice
+    mesh = slice_topology_mesh(
+        2, MeshSpec(data=1, fsdp=2, tensor=2), devices=devices[:8]
+    )
+    assert dict(zip(mesh.axis_names, mesh.devices.shape))["data"] == 2
+    rules = tp_rules()
+    cfg = LlamaConfig.tiny()
+    optimizer = optax.adamw(1e-3)
+    params, opt_state = init_sharded(
+        cfg, mesh, rules, jax.random.PRNGKey(0), optimizer
+    )
+    step = make_train_step(cfg, optimizer, mesh=mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size, jnp.int32)
+    bs = batch_sharding(mesh, rules)
+    batch = {
+        "tokens": jax.device_put(tokens, bs),
+        "targets": jax.device_put(tokens, bs),
+    }
+    (params, opt_state), loss = step((params, opt_state), batch)
+    loss = float(loss)
+    assert loss == loss and abs(loss) < 1e6
+    # params sharded across BOTH slices' devices
+    wq = params["layers"][0]["wq"]
+    assert len({s.device.id for s in wq.addressable_shards}) == 8
