@@ -19,6 +19,7 @@ from ray_tpu._version import version as __version__
 from ray_tpu.core import api as _api
 from ray_tpu.core.actor import ActorClass, ActorHandle, get_actor, kill, method
 from ray_tpu.core.api import init, is_initialized, shutdown
+from ray_tpu.core.deadline import Deadline, deadline_scope
 from ray_tpu.core.exceptions import (
     ActorDiedError,
     ActorError,
@@ -61,6 +62,8 @@ __all__ = [
     "available_resources",
     "free",
     "timeline",
+    "Deadline",
+    "deadline_scope",
     "__version__",
 ]
 

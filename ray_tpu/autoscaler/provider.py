@@ -84,24 +84,11 @@ class FakeMultiNodeProvider(NodeProvider):
             rec = self._nodes.pop(provider_id, None)
         if rec is None:
             return
-        proc = rec["proc"]
-        try:
-            import os
-            import signal
+        # escalating group reap (util/reaper.py): the daemon AND its
+        # workers go down, bounded, even if SIGTERM is ignored
+        from ray_tpu.util.reaper import reap_process
 
-            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
-        except Exception:
-            try:
-                proc.terminate()
-            except Exception:
-                pass
-        try:
-            proc.wait(timeout=10)
-        except Exception:
-            try:
-                proc.kill()
-            except Exception:
-                pass
+        reap_process(rec["proc"], group=True)
 
     def non_terminated_nodes(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -16,7 +16,7 @@ import sys
 import time
 from typing import Dict, List, Optional
 
-from ray_tpu.core.cluster_backend import _stop, _subprocess_env, spawn_node
+from ray_tpu.core.cluster_backend import _subprocess_env, spawn_node
 
 
 class Cluster:
@@ -81,17 +81,17 @@ class Cluster:
             self.nodes.remove(proc)
 
     def shutdown(self) -> None:
-        for proc in list(self.nodes):
-            try:
-                os.killpg(os.getpgid(proc.pid), 15)
-            except Exception:
-                pass
-        _stop(self._head)
-        for proc in list(self.nodes):
-            try:
-                proc.wait(timeout=5)
-            except Exception:
-                try:
-                    os.killpg(os.getpgid(proc.pid), 9)
-                except Exception:
-                    pass
+        """Escalating teardown of every process this cluster spawned: one
+        shared SIGTERM grace across node groups + the head group, SIGKILL
+        survivors (util/reaper.py). Bounded — a SIGTERM-ignoring daemon
+        cannot wedge the test that owns this cluster."""
+        from ray_tpu.util.reaper import reap_all
+
+        leaked = reap_all(list(self.nodes) + [self._head], group=True)
+        if leaked:
+            import logging
+
+            logging.getLogger(__name__).error(
+                "cluster shutdown left unreapable pids: %s", leaked
+            )
+        self.nodes.clear()

@@ -199,7 +199,12 @@ class Worker:
             refs = [refs]
         if not all(isinstance(r, ObjectRef) for r in refs):
             raise TypeError("get() expects an ObjectRef or a list of ObjectRefs")
-        values = self.backend.get_objects(refs, timeout)
+        # ambient Deadline (core/deadline.py): the tighter of the explicit
+        # timeout and the caller's remaining budget wins — a timeout=None
+        # get inside a deadline scope cannot park past the budget
+        from ray_tpu.core.deadline import effective_timeout
+
+        values = self.backend.get_objects(refs, effective_timeout(timeout))
         out = []
         for v in values:
             if isinstance(v, Exception):
@@ -214,7 +219,11 @@ class Worker:
             raise ValueError("wait() got duplicate ObjectRefs")
         if num_returns <= 0 or num_returns > len(refs):
             raise ValueError(f"num_returns must be in [1, {len(refs)}]")
-        return self.backend.wait(list(refs), num_returns, timeout, fetch_local)
+        from ray_tpu.core.deadline import effective_timeout
+
+        return self.backend.wait(
+            list(refs), num_returns, effective_timeout(timeout), fetch_local
+        )
 
     # ---- task submission ----------------------------------------------
     def _serialize_args(self, args, kwargs):
@@ -325,6 +334,8 @@ class Worker:
             # re-executing a partially-consumed stream has replay
             # semantics this build doesn't implement — no retries
             max_retries = 0
+        from ray_tpu.core.deadline import remaining as _deadline_remaining
+
         return TaskSpec(
             kind=kind,
             task_id=task_id,
@@ -341,6 +352,7 @@ class Worker:
             max_retries=max_retries,
             retry_exceptions=opts.retry_exceptions,
             runtime_env=runtime_env,
+            deadline_remaining_s=_deadline_remaining(),
             actor_id=actor_id,
             max_restarts=opts.max_restarts,
             max_task_retries=opts.max_task_retries,

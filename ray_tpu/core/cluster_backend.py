@@ -32,6 +32,13 @@ def _subprocess_env() -> dict:
     existing = env.get("PYTHONPATH", "")
     if pkg_root not in existing.split(os.pathsep):
         env["PYTHONPATH"] = pkg_root + (os.pathsep + existing if existing else "")
+    # every process spawned through here belongs to THIS driver: it must
+    # exit (gracefully) if the driver dies without running shutdown —
+    # the orphaned-head_main leak class (util/reaper.start_orphan_watch)
+    from ray_tpu.util.reaper import EXIT_ON_DRIVER_EXIT_ENV, SPAWNER_PID_ENV
+
+    env[EXIT_ON_DRIVER_EXIT_ENV] = "1"
+    env[SPAWNER_PID_ENV] = str(os.getpid())
     return env
 
 
@@ -140,11 +147,11 @@ def spawn_node(
 
 
 def _stop(proc: subprocess.Popen) -> None:
-    try:
-        proc.terminate()
-        proc.wait(timeout=5)
-    except Exception:
-        try:
-            proc.kill()
-        except Exception:
-            pass
+    """Escalating stop of a spawned runtime process AND its process group
+    (head/node daemons run with start_new_session=True and own their
+    workers' group): SIGTERM → grace → SIGKILL, always bounded. The group
+    kill is what prevents the round-5 "orphaned head_main" leak class —
+    terminating only the leader leaves its children reparented to init."""
+    from ray_tpu.util.reaper import reap_process
+
+    reap_process(proc, group=True)

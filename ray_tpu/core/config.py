@@ -123,6 +123,24 @@ class GlobalConfig:
     #: RAY_TPU_strip_child_env="" to disable.
     strip_child_env: str = "PALLAS_AXON_POOL_IPS"
 
+    # --- hang defense (observability/event_stats.py, util/reaper.py) ---
+    #: instrument owned asyncio loops with a heartbeat + stall watchdog
+    event_loop_monitor_enabled: bool = True
+    #: heartbeat period; also the watchdog's check interval
+    event_loop_tick_s: float = 0.1
+    #: heartbeat silence that counts as a stall (dump + stall counter).
+    #: The loop-lag gauge is exported regardless; this only gates dumps.
+    event_loop_stall_threshold_s: float = 5.0
+    #: rate limit between stack dumps while a stall persists
+    event_loop_stall_dump_interval_s: float = 30.0
+    #: >0: a stall persisting this long HARD-EXITS the process (code 70).
+    #: Off by default — production stalls should dump and recover; tests
+    #: set it so a wedged process dies visibly instead of freezing pytest.
+    watchdog_abort_after_s: float = 0.0
+    #: escalating reap: SIGTERM grace before SIGKILL, then SIGKILL grace
+    reap_term_grace_s: float = 2.0
+    reap_kill_grace_s: float = 3.0
+
     # --- RPC ---
     rpc_connect_timeout_s: float = 10.0
     rpc_retry_base_delay_s: float = 0.05

@@ -132,6 +132,11 @@ class Controller:
         if self.persist_path:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
         self._start_metrics()
+        # hang defense: stall watchdog on the control-plane loop (one
+        # blocked handler here wedges the whole cluster's control plane)
+        from ray_tpu.observability.event_stats import install_loop_monitor
+
+        install_loop_monitor(asyncio.get_event_loop(), "controller")
         return port
 
     # ---- persistence (GCS restart recovery) ----------------------------
@@ -308,6 +313,9 @@ class Controller:
 
     async def stop(self) -> None:
         self._stopping = True
+        from ray_tpu.observability.event_stats import remove_loop_monitor
+
+        remove_loop_monitor(asyncio.get_event_loop())
         if self._persist_task is not None:
             self._persist_task.cancel()
             # final consistent snapshot on clean shutdown (atomic write:
@@ -983,3 +991,10 @@ class Controller:
 
     async def c_ping(self, payload, conn):
         return "pong"
+
+    async def c_event_stats(self, payload, conn):
+        """Debug state (reference DebugString + event_stats.h): per-handler
+        timing plus loop-lag/stall counters of THIS process's loops."""
+        from ray_tpu.observability.event_stats import debug_snapshot
+
+        return debug_snapshot()

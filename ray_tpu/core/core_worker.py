@@ -1744,6 +1744,16 @@ class CoreWorker(RuntimeBackend):
         if mgr is not None:
             ids = payload.get("ids")
             if ids:
+                # undo ONLY the daemon's chip-less CPU pin from spawn time
+                # (jax has not initialized yet — the daemon grants the
+                # lease only after this reply): restore the pre-pin value
+                # rather than clobbering an operator-set JAX_PLATFORMS
+                prepin = os.environ.pop("RAY_TPU_PREPIN_JAX_PLATFORMS", None)
+                if prepin is not None:
+                    if prepin:
+                        os.environ["JAX_PLATFORMS"] = prepin
+                    else:
+                        os.environ.pop("JAX_PLATFORMS", None)
                 mgr.set_current_process_visible_accelerator_ids([str(i) for i in ids])
         return True
 

@@ -46,6 +46,12 @@ async def amain(args) -> None:
     loop = asyncio.get_event_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop.set)
+    # driver-owned clusters die with their driver (hang defense: a
+    # SIGKILLed pytest/bench must not orphan head_main forever); the
+    # detached CLI path never sets the env var, so it survives
+    from ray_tpu.util.reaper import start_orphan_watch
+
+    start_orphan_watch(lambda: loop.call_soon_threadsafe(stop.set))
     await stop.wait()
     await daemon.stop()
     await controller.stop()

@@ -192,6 +192,8 @@ class LocalBackend(RuntimeBackend):
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
         aid = spec.actor_id
+        streaming = spec.num_returns == "streaming"
+        stream = self._streams.get(spec.task_id.binary()) if streaming else None
         with self._lock:
             instance = self._actors.get(aid)
         if instance is None:
@@ -200,6 +202,11 @@ class LocalBackend(RuntimeBackend):
             with self._lock:
                 for oid in spec.return_ids:
                     self._store[oid] = err
+            if stream is not None:
+                # streaming specs have no return ids: the error must reach
+                # the stream or the generator blocks forever (the hang the
+                # round-5 advisor flagged)
+                stream.fail(err)
             return
         if spec.method_name == "__ray_ready__":
             with self._lock:
@@ -217,6 +224,29 @@ class LocalBackend(RuntimeBackend):
             with self._lock:
                 for oid in spec.return_ids:
                     self._store[oid] = e
+            if stream is not None:
+                stream.fail(e)
+            return
+        if streaming:
+            if stream is None:
+                raise RuntimeError(
+                    "streaming actor task submitted without create_stream"
+                )
+            # mirror submit_task's streaming branch: iterate the generator
+            # eagerly (local mode is eager), feeding the stream item ids
+            count = 0
+            try:
+                with self._actor_locks[aid]:
+                    for value in fn(*args, **kwargs):
+                        count += 1
+                        oid = ObjectID.from_index(spec.task_id, count)
+                        with self._lock:
+                            self._store_result(oid, value)
+                        stream.append(count, oid)
+            except Exception as e:  # noqa: BLE001
+                stream.fail(TaskError(spec.name, e))
+                return
+            stream.complete(count)
             return
         with self._actor_locks[aid]:
             results = execution.run_function(spec, fn, args, kwargs)

@@ -149,6 +149,11 @@ class NodeDaemon:
     async def start(self) -> int:
         port = await self.server.start()
         self.port = port
+        # hang defense: a blocked daemon loop freezes leases/object pulls
+        # for every worker on this node — watchdog it
+        from ray_tpu.observability.event_stats import install_loop_monitor
+
+        install_loop_monitor(asyncio.get_event_loop(), "node_daemon")
         self._start_metrics()
         await self._register_with_controller(port)
         self._tasks.append(asyncio.ensure_future(self._sync_loop()))
@@ -268,6 +273,9 @@ class NodeDaemon:
 
     async def stop(self) -> None:
         self._stopping = True
+        from ray_tpu.observability.event_stats import remove_loop_monitor
+
+        remove_loop_monitor(asyncio.get_event_loop())
         if getattr(self, "_metrics_server", None) is not None:
             from ray_tpu.observability.metrics import remove_collect
 
@@ -275,19 +283,20 @@ class NodeDaemon:
             self._metrics_server.stop()
         for t in self._tasks:
             t.cancel()
-        for w in self.workers.values():
-            try:
-                w.proc.terminate()
-            except Exception:
-                pass
-        for w in self.workers.values():
-            try:
-                w.proc.wait(timeout=2)
-            except Exception:
-                try:
-                    w.proc.kill()
-                except Exception:
-                    pass
+        # Escalating reap of every child we spawned (hang defense): one
+        # shared SIGTERM grace for the whole pool, SIGKILL the survivors —
+        # a worker ignoring SIGTERM (stuck in native code, masked signal)
+        # must not outlive its daemon and leak into the next session. Off
+        # the event loop: wait() grace windows would block it.
+        from ray_tpu.util.reaper import reap_all
+
+        procs = [w.proc for w in self.workers.values()]
+        if procs:
+            survivors = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: reap_all(procs)
+            )
+            if survivors:
+                logger.error("unreapable worker pids (D-state?): %s", survivors)
         await self.controller.close()
         for c in self._peer_clients.values():
             await c.close()
@@ -428,6 +437,10 @@ class NodeDaemon:
         actor_spec: Optional[TaskSpec] = None,
         tpu_chips: Optional[List[int]] = None,
     ) -> WorkerProc:
+        if self._stopping:
+            # a lease racing shutdown must not spawn a worker the stop()
+            # reap snapshot will never see (leak defense)
+            raise RuntimeError("daemon is stopping")
         token = os.urandom(8).hex()
         log_path = os.path.join(self.session_dir, "logs", f"worker-{token}.log")
         log_f = open(log_path, "ab")
@@ -435,8 +448,11 @@ class NodeDaemon:
         env["RAY_TPU_SPAWN_TOKEN"] = token
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         env["RAY_TPU_DAEMON_ADDR"] = f"{self.host}:{self.port}"
+        # explicit parent pid: the worker's orphan watch must not trust
+        # os.getppid() captured at ITS boot — the daemon can die during
+        # that window and the worker would memorize the reparented value
+        env["RAY_TPU_DAEMON_PID"] = str(os.getpid())
         env["RAY_TPU_CONTROLLER_ADDR"] = f"{self.controller_addr[0]}:{self.controller_addr[1]}"
-        env.pop("JAX_PLATFORMS", None)  # workers decide their own platform
         # CPU workers: strip accelerator-tunnel env triggers (each one
         # starts a per-process relay client burning ~half a core — see
         # GlobalConfig.strip_child_env). TPU-assigned workers RESTORE the
@@ -447,7 +463,21 @@ class NodeDaemon:
         chips = tpu_chips
         if chips is None:
             scrub_child_env(env)
+            # Chip-less workers are pinned to CPU (hang defense): a bare
+            # `import jax` in one would otherwise probe the TPU runtime —
+            # minutes of instance-metadata retries on non-TPU hosts (the
+            # round-5 "suite wedged" class), or grabbing every chip on a
+            # real TPU host. A pooled worker later PROMOTED to TPU undoes
+            # only THIS pin in w_set_accelerator_env (restoring whatever
+            # the operator had set, "" = unset), before jax initializes.
+            env["RAY_TPU_PREPIN_JAX_PLATFORMS"] = env.get("JAX_PLATFORMS") or ""
+            env["JAX_PLATFORMS"] = "cpu"
         else:
+            # TPU-assigned workers: an operator-set JAX_PLATFORMS passes
+            # through untouched (same contract as the promotion path in
+            # w_set_accelerator_env — the two chip-grant paths must not
+            # place the same env on different devices); unset means jax
+            # picks the TPU it was given.
             restore_scrubbed_env(env)
         # Dedicated actor workers get their chip isolation at spawn time —
         # before libtpu can initialize (TPU_VISIBLE_CHIPS + topology bounds,
@@ -1019,3 +1049,10 @@ class NodeDaemon:
             "resources": self.resources.to_dict(),
             "metrics_port": getattr(self, "metrics_port", 0),
         }
+
+    async def d_event_stats(self, payload, conn):
+        """Per-handler timing + loop liveness (reference event_stats.h
+        debug dump) for this daemon process."""
+        from ray_tpu.observability.event_stats import debug_snapshot
+
+        return debug_snapshot()

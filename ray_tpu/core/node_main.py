@@ -41,6 +41,10 @@ async def amain(args) -> None:
     loop = asyncio.get_event_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop.set)
+    # see head_main: driver-owned nodes exit when their spawner dies
+    from ray_tpu.util.reaper import start_orphan_watch
+
+    start_orphan_watch(lambda: loop.call_soon_threadsafe(stop.set))
     await stop.wait()
     await daemon.stop()
 

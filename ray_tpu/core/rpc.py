@@ -113,6 +113,11 @@ class RpcServer:
     async def start(self) -> int:
         self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        # handler timing registry (reference event_stats.h): every dispatch
+        # below records queueing + run latency under the method name
+        from ray_tpu.observability.event_stats import GLOBAL_EVENT_STATS
+
+        GLOBAL_EVENT_STATS.ensure_metrics()
         return self.port
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -126,7 +131,9 @@ class RpcServer:
                     break
                 if kind != REQUEST:
                     continue
-                asyncio.ensure_future(self._dispatch(conn, seq, method, payload))
+                asyncio.ensure_future(
+                    self._dispatch(conn, seq, method, payload, time.monotonic())
+                )
         finally:
             self._conns.discard(conn)
             conn._closed = True
@@ -140,8 +147,11 @@ class RpcServer:
             except Exception:
                 pass
 
-    async def _dispatch(self, conn: "ServerConnection", seq: int, method: bytes, payload: bytes):
+    async def _dispatch(self, conn: "ServerConnection", seq: int, method: bytes, payload: bytes, enqueued_at: float = 0.0):
+        from ray_tpu.observability.event_stats import GLOBAL_EVENT_STATS
+
         handler = self._handlers.get(method)
+        started_at = time.monotonic()
         try:
             if handler is None:
                 raise RpcError(f"no handler for {method.decode()!r}")
@@ -157,6 +167,12 @@ class RpcServer:
                 await conn.send(REPLY_ERR, seq, method, pickle.dumps(e))
             except Exception:
                 logger.debug("failed to send error reply", exc_info=True)
+        finally:
+            GLOBAL_EVENT_STATS.record(
+                method.decode(errors="replace"),
+                started_at - enqueued_at if enqueued_at else 0.0,
+                time.monotonic() - started_at,
+            )
 
     async def stop(self) -> None:
         # Close live connections first: in py3.12 ``wait_closed`` waits for
@@ -407,6 +423,7 @@ class IoThread:
 
     def __init__(self, name: str = "ray-tpu-io"):
         self.loop = asyncio.new_event_loop()
+        self.monitor = None  # LoopMonitor (stall watchdog), set in _run
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
 
@@ -417,6 +434,12 @@ class IoThread:
         # Long-poll handlers park in the default executor; the stock pool
         # (cpu+4 threads) is far too small under many concurrent waiters.
         self.loop.set_default_executor(ThreadPoolExecutor(max_workers=64, thread_name_prefix="io-exec"))
+        # Stall watchdog (hang defense): a handler blocking THIS loop is
+        # invisible from outside — the monitor's heartbeat + off-loop
+        # watchdog turns "process frozen" into a named stack dump.
+        from ray_tpu.observability.event_stats import install_loop_monitor
+
+        self.monitor = install_loop_monitor(self.loop, self._thread.name)
         self.loop.run_forever()
 
     def run(self, coro, timeout: Optional[float] = None):
@@ -428,5 +451,10 @@ class IoThread:
         asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self) -> None:
+        # detach the watchdog FIRST: a stopping loop's silent heartbeat
+        # must not be reported (or worse, aborted) as a stall
+        from ray_tpu.observability.event_stats import remove_loop_monitor
+
+        remove_loop_monitor(self.loop)
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._thread.join(timeout=5)

@@ -257,6 +257,11 @@ class TaskExecutor:
             self._async_loop = asyncio.new_event_loop()
             t = threading.Thread(target=self._async_loop.run_forever, daemon=True, name="actor-async")
             t.start()
+            # user coroutines run here: a method that blocks this loop
+            # stalls every other async call on the actor — watchdog it
+            from ray_tpu.observability.event_stats import install_loop_monitor
+
+            install_loop_monitor(self._async_loop, "actor-async")
 
         loop0 = asyncio.get_event_loop()
         # arg resolution can block on remote objects — keep it off the io loop
@@ -270,12 +275,15 @@ class TaskExecutor:
             # deterministic driver id — two async actors in one job would
             # otherwise mint colliding ObjectIDs (shm segments are named by
             # ObjectID, so a collision silently overwrites data).
+            from ray_tpu.core.deadline import deadline_scope
+
             self.api_worker.job_id = spec.job_id
             self.api_worker.set_task_context(spec.task_id, spec.job_id)
             if self._async_sem is None:
                 self._async_sem = asyncio.Semaphore(max(1, self._max_concurrency))
             async with self._async_sem:
-                return await method(*args, **kwargs)
+                with deadline_scope(spec.deadline_remaining_s):
+                    return await method(*args, **kwargs)
 
         cfut = asyncio.run_coroutine_threadsafe(_run(), self._async_loop)
         loop = asyncio.get_event_loop()
@@ -291,11 +299,16 @@ class TaskExecutor:
     # ------------------------------------------------------------------
     def _execute(self, spec: TaskSpec, emit=None) -> List[Tuple[bytes, str, Any]]:
         """Runs on a lane thread. Returns packaged results."""
+        from ray_tpu.core.deadline import deadline_scope
         from ray_tpu.observability import timeline as _timeline
 
         _start_us = _timeline._now_us()
         try:
-            return self._execute_inner(spec, emit)
+            # re-enter the submitter's remaining budget: nested get()/wait()
+            # inside this task inherit the caller's deadline (deadline
+            # propagation, hang defense)
+            with deadline_scope(spec.deadline_remaining_s):
+                return self._execute_inner(spec, emit)
         finally:
             _timeline.record_event(
                 f"task::{spec.name}",

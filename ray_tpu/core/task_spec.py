@@ -130,6 +130,11 @@ class TaskSpec:
     max_retries: int = 0
     retry_exceptions: Any = False
     runtime_env: Optional[Dict[str, Any]] = None
+    # Remaining seconds of the submitter's ambient Deadline at submission
+    # (core/deadline.py): the executing worker re-enters this budget so
+    # nested get()/wait() inside the task inherit the caller's deadline
+    # instead of stacking fresh independent timeouts. None = no budget.
+    deadline_remaining_s: Optional[float] = None
     # actor creation
     actor_id: Optional[ActorID] = None
     max_restarts: int = 0

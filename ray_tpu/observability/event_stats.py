@@ -1,0 +1,392 @@
+"""Event-loop instrumentation + stall watchdog (the hang-defense core).
+
+Reference: ``src/ray/common/event_stats.h`` — every asio handler in the
+reference runtime is wrapped with queueing/run timing, and the stats are
+dumped into debug state so a wedged process self-diagnoses. This module
+is the asyncio equivalent, plus the piece the reference keeps separate
+(``GcsHealthCheckManager``-style liveness) folded into the same layer:
+
+* :class:`EventStats` — per-process registry of per-handler stats
+  (call count, queueing delay, run latency, max run latency), exported
+  through ``observability/metrics.py`` as Prometheus series.
+* :class:`LoopMonitor` — a heartbeat coroutine on one asyncio loop plus
+  a watchdog *thread* that notices when the heartbeat stops. A loop
+  stalled past ``event_loop_stall_threshold_s`` gets every thread's
+  stack plus the loop's pending asyncio task names dumped to the log
+  (faulthandler-style), so "the suite wedged" becomes "handler X blocked
+  in frame Y". In test mode (``watchdog_abort_after_s > 0``) a stall
+  that persists hard-aborts the process — a crashed test names its
+  killer; a frozen one wedges the whole suite.
+
+The watchdog runs OFF the loop it guards (a stalled loop cannot run its
+own diagnostics) and keeps no strong refs to handlers, so installing it
+costs one timer wakeup per ``event_loop_tick_s``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+
+logger = logging.getLogger(__name__)
+
+#: exit code for a watchdog hard-abort (distinct from crashes/SIGKILL so
+#: the reaping layer can attribute the death)
+WATCHDOG_ABORT_EXIT_CODE = 70
+
+#: process-local hard-abort override: a test DRIVER (pytest) sets this so
+#: its own loop stalls dump-but-never-abort — killing the driver kills
+#: the whole suite, and the per-test faulthandler timeout already bounds
+#: driver wedges. Spawned runtime processes (which don't run conftest)
+#: keep the abort. Config (``watchdog_abort_after_s``) can't express
+#: this: the driver serializes its config into every child it spawns.
+ABORT_DISABLED_IN_PROCESS = False
+
+
+class _HandlerStats:
+    __slots__ = ("count", "queue_total_s", "run_total_s", "run_max_s")
+
+    def __init__(self):
+        self.count = 0
+        self.queue_total_s = 0.0
+        self.run_total_s = 0.0
+        self.run_max_s = 0.0
+
+
+class EventStats:
+    """Per-process handler timing registry (``event_stats.h`` analogue)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handlers: Dict[str, _HandlerStats] = {}
+        self._metrics_registered = False
+
+    def record(self, handler: str, queue_s: float, run_s: float) -> None:
+        with self._lock:
+            st = self._handlers.get(handler)
+            if st is None:
+                st = self._handlers[handler] = _HandlerStats()
+            st.count += 1
+            st.queue_total_s += max(0.0, queue_s)
+            st.run_total_s += max(0.0, run_s)
+            if run_s > st.run_max_s:
+                st.run_max_s = run_s
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {
+                    "count": st.count,
+                    "queue_total_s": st.queue_total_s,
+                    "run_total_s": st.run_total_s,
+                    "run_max_s": st.run_max_s,
+                }
+                for name, st in self._handlers.items()
+            }
+
+    def ensure_metrics(self) -> None:
+        """Register Prometheus series lazily (first instrumented handler)
+        so importing this module never touches the metrics registry."""
+        with self._lock:
+            if self._metrics_registered:
+                return
+            self._metrics_registered = True
+        from ray_tpu.observability.metrics import Counter, Gauge, on_collect
+
+        c_calls = Counter(
+            "raytpu_handler_calls_total", "instrumented handler invocations", ("handler",)
+        )
+        g_queue = Gauge(
+            "raytpu_handler_queue_seconds_total",
+            "cumulative handler queueing delay (enqueue to run)",
+            ("handler",),
+        )
+        g_run = Gauge(
+            "raytpu_handler_run_seconds_total", "cumulative handler run time", ("handler",)
+        )
+        g_max = Gauge(
+            "raytpu_handler_run_max_seconds", "max single-invocation run time", ("handler",)
+        )
+        seen_counts: Dict[str, float] = {}
+
+        def sample() -> None:
+            for name, st in self.snapshot().items():
+                labels = {"handler": name}
+                prev = seen_counts.get(name, 0.0)
+                if st["count"] > prev:
+                    c_calls.inc(st["count"] - prev, labels)
+                    seen_counts[name] = st["count"]
+                g_queue.set(st["queue_total_s"], labels)
+                g_run.set(st["run_total_s"], labels)
+                g_max.set(st["run_max_s"], labels)
+
+        on_collect(sample)
+
+
+#: process-wide registry — every RpcServer dispatch in this process
+#: records here regardless of which loop it runs on
+GLOBAL_EVENT_STATS = EventStats()
+
+
+def format_stall_dump(loop: Optional[asyncio.AbstractEventLoop], loop_thread_ident: Optional[int], name: str, silent_s: float) -> str:
+    """All-thread stack dump + pending asyncio task names, with the
+    stalled loop's thread called out (its top frame IS the blocking
+    handler)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines: List[str] = [
+        f"=== ray_tpu watchdog: event loop {name!r} stalled for {silent_s:.1f}s "
+        f"(pid={os.getpid()}) ===",
+    ]
+    for ident, frame in sys._current_frames().items():
+        marker = ""
+        if loop_thread_ident is not None and ident == loop_thread_ident:
+            marker = "  <<< STALLED EVENT LOOP — blocking handler below"
+        lines.append(f"--- thread {names.get(ident, '?')} (ident={ident}){marker} ---")
+        lines.extend(l.rstrip("\n") for l in traceback.format_stack(frame))
+    if loop is not None:
+        try:
+            # best-effort from another thread: the WeakSet iteration can
+            # race task creation — a diagnostics dump must never throw
+            tasks = [t for t in asyncio.all_tasks(loop) if not t.done()]
+            lines.append(f"--- {len(tasks)} pending asyncio tasks on {name!r} ---")
+            for t in tasks[:200]:
+                coro = t.get_coro()
+                lines.append(f"  {t.get_name()}: {getattr(coro, '__qualname__', coro)!r}")
+        except Exception:
+            lines.append("--- pending task listing unavailable (racing loop) ---")
+    lines.append("=== end watchdog dump ===")
+    return "\n".join(lines)
+
+
+class LoopMonitor:
+    """Heartbeat + watchdog for one asyncio loop.
+
+    The heartbeat coroutine wakes every ``event_loop_tick_s``, measures
+    its own scheduling lag (how late the wakeup fired — the loop-lag
+    gauge) and stamps ``_last_beat``. The watchdog thread declares a
+    stall when the stamp goes silent past
+    ``event_loop_stall_threshold_s`` and dumps diagnostics; with
+    ``watchdog_abort_after_s > 0`` a persistent stall hard-exits the
+    process (test mode: convert a wedge into an attributable crash)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, name: str):
+        self.loop = loop
+        self.name = name
+        self.stall_count = 0
+        self.last_dump_text = ""
+        self.max_lag_s = 0.0
+        self.on_stall: List[Callable[[str, float], None]] = []
+        self._last_beat = time.monotonic()
+        self._loop_thread_ident: Optional[int] = None
+        self._stall_started: Optional[float] = None
+        self._last_dump_at = 0.0
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._beat_task: Optional[asyncio.Task] = None
+        self._window_lag_s = 0.0  # max since last scrape (gauge source)
+        self._g_lag = None
+        self._c_stalls = None
+        self._metrics_cb = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "LoopMonitor":
+        from ray_tpu.observability.metrics import Counter, Gauge, on_collect
+
+        self._g_lag = Gauge(
+            "raytpu_event_loop_lag_seconds",
+            "heartbeat scheduling lag of the process event loop (max since last scrape)",
+            ("loop",),
+        )
+        self._c_stalls = Counter(
+            "raytpu_event_loop_stalls_total",
+            "event-loop stalls detected by the watchdog",
+            ("loop",),
+        )
+
+        def _sample() -> None:
+            # windowed max, reset per scrape — a one-off startup stall
+            # must not pin the gauge at its historical peak forever
+            self._g_lag.set(self._window_lag_s, {"loop": self.name})
+            self._window_lag_s = 0.0
+
+        self._metrics_cb = on_collect(_sample)
+        def _schedule() -> None:
+            self._beat_task = asyncio.ensure_future(self._beat())
+
+        self.loop.call_soon_threadsafe(_schedule)
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True, name=f"loop-watchdog-{self.name}"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._metrics_cb is not None:
+            from ray_tpu.observability.metrics import remove_collect
+
+            remove_collect(self._metrics_cb)
+            self._metrics_cb = None
+        task = self._beat_task
+        if task is not None and not self.loop.is_closed():
+            try:
+                self.loop.call_soon_threadsafe(task.cancel)
+            except RuntimeError:
+                pass  # loop already closing
+
+    # -- heartbeat (runs ON the guarded loop) ----------------------------
+    async def _beat(self) -> None:
+        self._loop_thread_ident = threading.get_ident()
+        while not self._stopped.is_set():
+            tick = max(0.01, GLOBAL_CONFIG.event_loop_tick_s)
+            t0 = time.monotonic()
+            try:
+                await asyncio.sleep(tick)
+            except asyncio.CancelledError:
+                return
+            now = time.monotonic()
+            lag = max(0.0, (now - t0) - tick)
+            if lag > self.max_lag_s:
+                self.max_lag_s = lag  # lifetime max (debug snapshot)
+            if lag > self._window_lag_s:
+                self._window_lag_s = lag  # per-scrape max (gauge)
+            self._last_beat = now
+            self._stall_started = None  # loop is alive again
+
+    # -- watchdog (its own thread) ---------------------------------------
+    def _watch(self) -> None:
+        while not self._stopped.wait(max(0.05, GLOBAL_CONFIG.event_loop_tick_s)):
+            if self.loop.is_closed() or not self.loop.is_running():
+                continue  # startup/shutdown windows are not stalls
+            if self._loop_thread_ident is None:
+                continue  # heartbeat not scheduled yet
+            threshold = GLOBAL_CONFIG.event_loop_stall_threshold_s
+            if threshold <= 0:
+                continue
+            silent = time.monotonic() - self._last_beat
+            if silent <= threshold + GLOBAL_CONFIG.event_loop_tick_s:
+                self._stall_started = None
+                continue
+            now = time.monotonic()
+            # local snapshot: the loop thread clears _stall_started on
+            # recovery concurrently — `now - None` would kill this thread
+            # and silently remove the safety net
+            started = self._stall_started
+            if started is None:
+                started = self._stall_started = now
+                self.stall_count += 1
+                if self._c_stalls is not None:
+                    self._c_stalls.inc(labels={"loop": self.name})
+            if now - self._last_dump_at >= GLOBAL_CONFIG.event_loop_stall_dump_interval_s:
+                self._last_dump_at = now
+                self._dump(silent)
+            abort_after = GLOBAL_CONFIG.watchdog_abort_after_s
+            if (
+                abort_after > 0
+                and not ABORT_DISABLED_IN_PROCESS
+                and now - started >= abort_after
+            ):
+                self._abort(silent)
+
+    @staticmethod
+    def _dump_path() -> str:
+        return f"/tmp/ray_tpu/watchdog-{os.getpid()}.log"
+
+    def _write_dump_file(self, text: str) -> None:
+        """Post-mortem file: stderr may be swallowed (pytest fd capture
+        dies with the process on a hard abort) — the file survives."""
+        try:
+            os.makedirs("/tmp/ray_tpu", exist_ok=True)
+            with open(self._dump_path(), "a") as f:
+                f.write(text + "\n")
+        except Exception:
+            pass
+
+    def _dump(self, silent: float) -> None:
+        try:
+            text = format_stall_dump(
+                self.loop, self._loop_thread_ident, self.name, silent
+            )
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            text = f"watchdog: loop {self.name!r} stalled {silent:.1f}s (dump failed)"
+        self.last_dump_text = text
+        print(text, file=sys.stderr, flush=True)
+        self._write_dump_file(text)
+        logger.warning("event loop stall detected:\n%s", text)
+        for cb in list(self.on_stall):
+            try:
+                cb(text, silent)
+            except Exception:
+                pass
+
+    def _abort(self, silent: float) -> None:
+        msg = (
+            f"ray_tpu watchdog: loop {self.name!r} stalled {silent:.1f}s > "
+            f"watchdog_abort_after_s={GLOBAL_CONFIG.watchdog_abort_after_s}; aborting pid {os.getpid()}"
+        )
+        print(msg, file=sys.stderr, flush=True)
+        self._write_dump_file(msg)
+        try:
+            import faulthandler
+
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+            with open(self._dump_path(), "a") as f:
+                faulthandler.dump_traceback(file=f, all_threads=True)
+        except Exception:
+            pass
+        os._exit(WATCHDOG_ABORT_EXIT_CODE)
+
+
+# -- per-process monitor registry ---------------------------------------
+_MONITORS_LOCK = threading.Lock()
+_MONITORS: Dict[int, LoopMonitor] = {}
+
+
+def install_loop_monitor(loop: asyncio.AbstractEventLoop, name: str) -> Optional[LoopMonitor]:
+    """Attach a LoopMonitor to ``loop`` (idempotent per loop). Returns
+    None when monitoring is disabled by config."""
+    if not GLOBAL_CONFIG.event_loop_monitor_enabled:
+        return None
+    with _MONITORS_LOCK:
+        existing = _MONITORS.get(id(loop))
+        if existing is not None:
+            return existing
+        monitor = _MONITORS[id(loop)] = LoopMonitor(loop, name)
+    return monitor.start()
+
+
+def remove_loop_monitor(loop: asyncio.AbstractEventLoop) -> None:
+    with _MONITORS_LOCK:
+        monitor = _MONITORS.pop(id(loop), None)
+    if monitor is not None:
+        monitor.stop()
+
+
+def get_loop_monitors() -> List[LoopMonitor]:
+    with _MONITORS_LOCK:
+        return list(_MONITORS.values())
+
+
+def debug_snapshot() -> Dict[str, object]:
+    """The process's event-stats debug state (reference DebugString):
+    served verbatim by the controller's and daemons' ``event_stats``
+    RPCs — one definition so the payload cannot drift between them."""
+    return {
+        "handlers": GLOBAL_EVENT_STATS.snapshot(),
+        "loops": [
+            {
+                "name": m.name,
+                "max_lag_s": m.max_lag_s,
+                "stall_count": m.stall_count,
+            }
+            for m in get_loop_monitors()
+        ],
+    }

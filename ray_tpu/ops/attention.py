@@ -37,6 +37,14 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+def _tpu_compiler_params(pltpu):
+    """jax renamed ``TPUCompilerParams`` -> ``CompilerParams`` (~0.5):
+    resolve whichever this jax ships so the kernels run on both."""
+    cp = getattr(pltpu, "CompilerParams", None)
+    return cp if cp is not None else pltpu.TPUCompilerParams
+
+
+
 def default_blocks(seq_q: int) -> tuple:
     """Tuned on v5e (round-5 sweep, fwd+bwd which is what training
     runs): (512, 1024) wins at s=2048 (67 vs 57 TFLOP/s) AND s=8192
@@ -218,7 +226,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_use_interpret(),
@@ -364,7 +372,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, h, hk, res, g):
         out_specs=pl.BlockSpec((1, block_q, d), q_idx),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_use_interpret(),
@@ -413,7 +421,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, h, hk, res, g):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_use_interpret(),

@@ -27,6 +27,7 @@ import weakref
 from typing import Any, List, Optional
 
 import ray_tpu
+from ray_tpu.core.deadline import Deadline, effective_timeout
 from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
 
 _STATS_TTL_S = 0.25
@@ -200,17 +201,22 @@ class Router:
     ):
         """Retry-until-executed (reference router semantics): a dispatch
         that lands on a dying replica re-chooses. App-level exceptions
-        are NOT retried — only replica death/crash."""
-        deadline = time.monotonic() + (timeout if timeout is not None else 3600)
+        are NOT retried — only replica death/crash.
+
+        One Deadline covers the whole call (core/deadline.py): dispatch
+        retries AND the result get draw from the same budget, clamped by
+        any ambient deadline of the caller — inner timeouts never stack."""
+        budget = effective_timeout(timeout)
+        deadline = Deadline.after(budget if budget is not None else 3600)
         last_err: Optional[Exception] = None
-        while time.monotonic() < deadline:
+        while not deadline.expired:
             replica = self.choose_replica(model_id)
             self._bump(replica)
             ref = replica.handle_request.remote(
                 method, list(args), dict(kwargs or {}), model_id
             )
             try:
-                remaining = max(1.0, deadline - time.monotonic())
+                remaining = max(1.0, deadline.remaining())
                 return ray_tpu.get(ref, timeout=remaining)
             except (ActorDiedError, WorkerCrashedError) as e:
                 last_err = e
@@ -231,10 +237,19 @@ class Router:
     ):
         """Streaming with dispatch retry: re-chooses if the stream dies
         BEFORE the first item (nothing was delivered, safe to replay);
-        mid-stream death propagates — replaying would duplicate items."""
-        deadline = time.monotonic() + (timeout if timeout is not None else 3600)
+        mid-stream death propagates — replaying would duplicate items.
+
+        The Deadline budget covers dispatch + time-to-first-item; after
+        that, each item get inherits the CALLER's timeout (None = wait
+        forever) — a slow producer mid-stream is backpressure, not a
+        dispatch failure, so it must not trip a fixed 60s timer."""
+        budget = effective_timeout(timeout)
+        deadline = Deadline.after(budget if budget is not None else 3600)
+        # per-item patience once streaming: the caller's timeout with any
+        # tighter ambient deadline already folded in; None = wait forever
+        item_timeout = budget
         last_err: Optional[Exception] = None
-        while time.monotonic() < deadline:
+        while not deadline.expired:
             replica = self.choose_replica(model_id)
             self._bump(replica)
             gen = replica.handle_request_streaming.options(
@@ -244,9 +259,9 @@ class Router:
                 # bounded time-to-first-item: a replica stuck before its
                 # first yield must not park this request forever
                 first_ref = gen.next_with_timeout(
-                    max(1.0, deadline - time.monotonic())
+                    max(1.0, deadline.remaining())
                 )
-                first = ray_tpu.get(first_ref, timeout=max(1.0, deadline - time.monotonic()))
+                first = ray_tpu.get(first_ref, timeout=max(1.0, deadline.remaining()))
             except StopIteration:
                 def _empty():
                     return
@@ -261,7 +276,7 @@ class Router:
             def _rest(first=first, it=it):
                 yield first
                 for ref in it:
-                    yield ray_tpu.get(ref, timeout=60)
+                    yield ray_tpu.get(ref, timeout=item_timeout)
 
             return _rest()
         raise last_err or TimeoutError(

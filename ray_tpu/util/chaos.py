@@ -24,29 +24,13 @@ from typing import List, Optional
 
 
 def find_worker_pids(controller_addr: str) -> List[int]:
-    """PIDs of worker_main processes attached to ``controller_addr``."""
-    me = os.getpid()
-    out: List[int] = []
-    for pid_s in os.listdir("/proc"):
-        if not pid_s.isdigit():
-            continue
-        pid = int(pid_s)
-        if pid == me:
-            continue
-        try:
-            with open(f"/proc/{pid}/cmdline", "rb") as f:
-                cmd = f.read().decode(errors="replace")
-            if "ray_tpu.core.worker_main" not in cmd:
-                continue
-            with open(f"/proc/{pid}/environ", "rb") as f:
-                env = f.read().decode(errors="replace")
-            # environ entries are NUL-separated: match the full value or
-            # ':812' would also claim another cluster's ':8123' workers
-            if f"RAY_TPU_CONTROLLER_ADDR={controller_addr}\x00" in env:
-                out.append(pid)
-        except (OSError, PermissionError):
-            continue  # raced process exit
-    return out
+    """PIDs of worker_main processes attached to ``controller_addr``
+    (shared /proc scan: ``util/reaper.py::find_runtime_pids``)."""
+    from ray_tpu.util.reaper import find_runtime_pids
+
+    return find_runtime_pids(
+        patterns=("ray_tpu.core.worker_main",), controller_addr=controller_addr
+    )
 
 
 class WorkerKiller:

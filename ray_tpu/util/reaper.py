@@ -1,0 +1,284 @@
+"""Escalating process reaping + runtime-process discovery.
+
+The failure mode this closes (round-5 verdict): suite runs wedging on
+leaked ``worker_main``/``node_main``/``head_main`` processes — a child
+that ignores SIGTERM (or whose parent died before waiting) survives
+teardown, holds ports/shm/CPU, and poisons every later test. Reaping
+here is *escalating* and *bounded*: SIGTERM → wait ``reap_term_grace_s``
+→ SIGKILL → wait ``reap_kill_grace_s`` → report. Nothing in this module
+ever blocks indefinitely.
+
+Discovery (``find_runtime_pids``) generalizes the ``/proc`` scan that
+``util/chaos.py::find_worker_pids`` pioneered: match runtime entrypoint
+cmdlines, optionally scoped to one cluster via the
+``RAY_TPU_CONTROLLER_ADDR`` env var — so a leak check (or a chaos
+killer) never touches another session's processes.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from typing import Iterable, List, Optional, Sequence, Union
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+
+#: cmdline markers of every process the runtime spawns
+RUNTIME_ENTRYPOINTS = (
+    "ray_tpu.core.worker_main",
+    "ray_tpu.core.node_main",
+    "ray_tpu.core.head_main",
+)
+
+ProcOrPid = Union[subprocess.Popen, int]
+
+
+def _pid_of(target: ProcOrPid) -> int:
+    return target.pid if isinstance(target, subprocess.Popen) else int(target)
+
+
+def _alive(target: ProcOrPid) -> bool:
+    if isinstance(target, subprocess.Popen):
+        return target.poll() is None
+    try:
+        os.kill(int(target), 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _signal(target: ProcOrPid, sig: int, *, group: bool) -> None:
+    pid = _pid_of(target)
+    try:
+        if group:
+            os.killpg(os.getpgid(pid), sig)
+        else:
+            os.kill(pid, sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+
+
+def _wait(target: ProcOrPid, grace_s: float) -> bool:
+    """Wait (bounded) for death; reaps the zombie when we're the parent.
+    Returns True when the process is gone."""
+    if isinstance(target, subprocess.Popen):
+        try:
+            target.wait(timeout=grace_s)
+            return True
+        except Exception:
+            return target.poll() is not None
+    deadline = time.monotonic() + grace_s
+    pid = int(target)
+    while time.monotonic() < deadline:
+        try:  # collect the zombie if it is our child
+            os.waitpid(pid, os.WNOHANG)
+        except (ChildProcessError, OSError):
+            pass
+        if not _alive(pid):
+            return True
+        time.sleep(0.05)
+    return not _alive(pid)
+
+
+def _group_pgid(target: ProcOrPid) -> Optional[int]:
+    try:
+        return os.getpgid(_pid_of(target))
+    except (ProcessLookupError, PermissionError, OSError):
+        return None
+
+
+def _group_alive(pgid: int) -> bool:
+    try:
+        os.killpg(pgid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True
+
+
+def _sweep_group_stragglers(pgid: Optional[int], kill_grace_s: float) -> bool:
+    """A group leader's clean exit does not prove its group is empty: a
+    worker spawned in the shutdown race window (or one that missed the
+    group SIGTERM) survives the leader. SIGKILL whatever remains in the
+    group and wait, bounded. Returns True when the group is empty."""
+    if pgid is None or not _group_alive(pgid):
+        return True
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+    deadline = time.monotonic() + kill_grace_s
+    while time.monotonic() < deadline:
+        if not _group_alive(pgid):
+            return True
+        time.sleep(0.05)
+    return not _group_alive(pgid)
+
+
+def reap_process(
+    target: ProcOrPid,
+    *,
+    term_grace_s: Optional[float] = None,
+    kill_grace_s: Optional[float] = None,
+    group: bool = False,
+) -> bool:
+    """SIGTERM → wait → SIGKILL → wait. Returns True when the process is
+    verifiably gone. ``group=True`` escalates the whole process group
+    (node daemons own their workers' group) and verifies the GROUP is
+    empty, not just the leader — stragglers are SIGKILLed."""
+    term_grace = term_grace_s if term_grace_s is not None else GLOBAL_CONFIG.reap_term_grace_s
+    kill_grace = kill_grace_s if kill_grace_s is not None else GLOBAL_CONFIG.reap_kill_grace_s
+    pgid = _group_pgid(target) if group else None
+    if not _alive(target):
+        _wait(target, 0.0)  # collect a zombie child
+        return _sweep_group_stragglers(pgid, kill_grace) if group else True
+    _signal(target, signal.SIGTERM, group=group)
+    gone = _wait(target, term_grace)
+    if not gone:
+        _signal(target, signal.SIGKILL, group=group)
+        gone = _wait(target, kill_grace)
+    if group:
+        gone = _sweep_group_stragglers(pgid, kill_grace) and gone
+    return gone
+
+
+def reap_all(
+    targets: Iterable[ProcOrPid],
+    *,
+    term_grace_s: Optional[float] = None,
+    kill_grace_s: Optional[float] = None,
+    group: bool = False,
+) -> List[int]:
+    """Escalate a set of processes CONCURRENTLY: one shared SIGTERM grace
+    (not N sequential ones), then SIGKILL the survivors. Returns pids
+    that still refused to die (should be empty; SIGKILL is not
+    ignorable, only D-state survives it)."""
+    targets = list(targets)
+    term_grace = term_grace_s if term_grace_s is not None else GLOBAL_CONFIG.reap_term_grace_s
+    kill_grace = kill_grace_s if kill_grace_s is not None else GLOBAL_CONFIG.reap_kill_grace_s
+    pgids = [_group_pgid(t) for t in targets] if group else []
+    live = [t for t in targets if _alive(t)]
+    for t in targets:
+        if t not in live:
+            _wait(t, 0.0)  # collect zombies
+    for t in live:
+        _signal(t, signal.SIGTERM, group=group)
+    deadline = time.monotonic() + term_grace
+    while live and time.monotonic() < deadline:
+        for t in live:
+            _wait(t, 0.0)  # collect zombies as they die
+        live = [t for t in live if _alive(t)]
+        if live:
+            time.sleep(0.05)
+    for t in live:
+        _signal(t, signal.SIGKILL, group=group)
+    survivors: List[int] = []
+    for t in live:
+        if not _wait(t, kill_grace):
+            survivors.append(_pid_of(t))
+    if group:
+        # leaders are gone; their groups may not be (shutdown-race spawns)
+        for pgid in pgids:
+            _sweep_group_stragglers(pgid, kill_grace)
+    return survivors
+
+
+def pid_alive(pid: int) -> bool:
+    """Liveness probe shared with the test-side leak guards."""
+    return _alive(int(pid))
+
+
+def find_runtime_pids(
+    patterns: Sequence[str] = RUNTIME_ENTRYPOINTS,
+    controller_addr: Optional[str] = None,
+    spawner_pid: Optional[int] = None,
+) -> List[int]:
+    """PIDs of runtime processes, by ``/proc`` cmdline scan. With
+    ``controller_addr``, only processes bound to that cluster match —
+    workers carry it in env (``RAY_TPU_CONTROLLER_ADDR``), node daemons
+    in their ``--controller`` cmdline arg. Full-value matching: ':812'
+    must not claim another cluster's ':8123' processes. With
+    ``spawner_pid``, only processes whose env stamps that spawning driver
+    (``RAY_TPU_SPAWNER_PID``, inherited daemon→worker) match — what lets
+    a leak guard ignore a sibling session's clusters entirely."""
+    me = os.getpid()
+    out: List[int] = []
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit():
+            continue
+        pid = int(pid_s)
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                raw_cmd = f.read()
+            cmd = raw_cmd.decode(errors="replace")
+            if not any(p in cmd for p in patterns):
+                continue
+            env = None
+            if controller_addr is not None:
+                # cmdline args are NUL-separated — exact-arg match
+                if f"\x00{controller_addr}\x00".encode() not in raw_cmd:
+                    with open(f"/proc/{pid}/environ", "rb") as f:
+                        env = f.read().decode(errors="replace")
+                    if f"RAY_TPU_CONTROLLER_ADDR={controller_addr}\x00" not in env:
+                        continue
+            if spawner_pid is not None:
+                if env is None:
+                    with open(f"/proc/{pid}/environ", "rb") as f:
+                        env = f.read().decode(errors="replace")
+                if f"{SPAWNER_PID_ENV}={spawner_pid}\x00" not in env:
+                    continue
+            out.append(pid)
+        except (OSError, PermissionError):
+            continue  # raced process exit
+    return out
+
+
+#: set by driver-owned spawn paths (``cluster_backend._subprocess_env``):
+#: daemons spawned FOR a driver/test exit when that driver dies. The CLI
+#: (``ray_tpu start``) deliberately does not set it — a detached cluster
+#: must survive its starter.
+EXIT_ON_DRIVER_EXIT_ENV = "RAY_TPU_EXIT_ON_DRIVER_EXIT"
+
+#: pid of the spawning driver, stamped by ``_subprocess_env`` — the
+#: orphan watch compares against THIS, not a boot-time ``os.getppid()``
+#: (the driver can die while the child is still importing, which would
+#: memorize the already-reparented value and never trigger)
+SPAWNER_PID_ENV = "RAY_TPU_SPAWNER_PID"
+
+
+def start_orphan_watch(on_orphan, *, hard_exit_after_s: float = 10.0):
+    """Watch for reparenting (our spawner died) and fire ``on_orphan``
+    for a graceful stop; hard-exit if the process is still alive after
+    ``hard_exit_after_s``. No-op unless ``RAY_TPU_EXIT_ON_DRIVER_EXIT=1``
+    in this process's env. Returns the watcher thread (or None).
+
+    This is the defense against the "orphaned head_main" leak class: a
+    driver killed without running shutdown (SIGKILLed pytest, crashed
+    bench script) leaves its cluster running forever otherwise."""
+    import threading
+
+    if os.environ.get(EXIT_ON_DRIVER_EXIT_ENV) != "1":
+        return None
+    expected_ppid = int(os.environ.get(SPAWNER_PID_ENV, 0)) or os.getppid()
+
+    def _watch() -> None:
+        while True:
+            if os.getppid() != expected_ppid:
+                try:
+                    on_orphan()
+                except Exception:
+                    pass
+                time.sleep(hard_exit_after_s)  # graceful-stop window
+                os._exit(0)
+            time.sleep(1.0)
+
+    t = threading.Thread(target=_watch, daemon=True, name="driver-orphan-watch")
+    t.start()
+    return t

@@ -8,6 +8,20 @@ multi-node ``cluster`` fixture (``python/ray/cluster_utils.py:135``).
 JAX-dependent tests run on a virtual 8-device CPU mesh: the env vars below
 must be set before jax initializes, which this conftest guarantees because
 pytest imports it before any test module.
+
+Hang defense (see ``ray_tpu/observability/event_stats.py`` and
+``ray_tpu/util/reaper.py``):
+
+* every test runs under a HARD timeout enforced by stdlib
+  ``faulthandler.dump_traceback_later(..., exit=True)`` — a wedged test
+  dumps every thread's stack and aborts the run instead of freezing the
+  suite (and the box) indefinitely;
+* spawned runtime processes run with ``watchdog_abort_after_s`` set, so a
+  daemon/worker whose event loop stalls hard-exits (code 70) after dumping
+  its stacks rather than holding ports/shm forever;
+* an autouse leak guard snapshots runtime pids around each test and FAILS
+  the test that leaked ``worker_main``/``node_main``/``head_main``
+  processes — "suite wedged 25 minutes" becomes a named failure.
 """
 
 import os
@@ -23,6 +37,14 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Test-mode hang defense: runtime processes spawned by tests inherit this
+# env, so a process whose event loop stalls past the threshold dumps
+# stacks and hard-exits instead of silently wedging the suite. Set before
+# importing ray_tpu (GLOBAL_CONFIG reads env at import).
+os.environ.setdefault("RAY_TPU_watchdog_abort_after_s", "120")
+
+import faulthandler  # noqa: E402
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -30,6 +52,22 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 import ray_tpu  # noqa: E402
+from ray_tpu.observability import event_stats as _event_stats  # noqa: E402
+from ray_tpu.util.reaper import find_runtime_pids, pid_alive, reap_all  # noqa: E402
+
+# The pytest process itself must never watchdog-ABORT (that kills the
+# whole suite; its wedges are bounded by the per-test faulthandler timer
+# below) — it still detects and DUMPS loop stalls. Spawned runtime
+# processes don't import this conftest and keep the 120s hard abort.
+_event_stats.ABORT_DISABLED_IN_PROCESS = True
+
+# faulthandler output must reach the REAL terminal even when pytest's
+# fd-level capture is active at dump time — keep a dup of stderr from
+# import time (capture is not yet installed for initial conftests)
+try:
+    _REAL_STDERR = os.fdopen(os.dup(2), "w")
+except OSError:
+    _REAL_STDERR = None
 
 
 @pytest.fixture
@@ -50,3 +88,123 @@ def ray_start_regular():
 def shutdown_only():
     yield
     ray_tpu.shutdown()
+
+
+#: shared capability gate (import as ``from conftest import ...``):
+#: jaxlib < 0.5 CPU backend has no cross-process collectives — a 2-proc
+#: allgather/psum dies with "Multiprocess computations aren't implemented
+#: on the CPU backend". The rendezvous itself (process_count) still works.
+multiprocess_cpu_collectives = pytest.mark.skipif(
+    tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5),
+    reason="jaxlib <0.5 CPU backend lacks multiprocess collectives",
+)
+
+
+# ---------------------------------------------------------------------------
+# per-test hard timeout (stdlib faulthandler, no plugin dependency)
+
+def pytest_addoption(parser):
+    parser.addini(
+        "raytpu_test_timeout",
+        "per-test hard timeout in seconds; on expiry every thread's stack is "
+        "dumped and the run aborts (faulthandler.dump_traceback_later). "
+        "0 disables. Env override: RAY_TPU_TEST_TIMEOUT_S.",
+        default="180",
+    )
+
+
+def _test_timeout(config) -> float:
+    try:
+        return float(
+            os.environ.get(
+                "RAY_TPU_TEST_TIMEOUT_S", config.getini("raytpu_test_timeout")
+            )
+        )
+    except (TypeError, ValueError):
+        return 180.0
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    timeout = _test_timeout(item.config)
+    armed = timeout > 0 and hasattr(faulthandler, "dump_traceback_later")
+    if armed:
+        # exit=True: a test that outlives the timer is unrecoverably wedged
+        # (futex/GIL/asyncio) — dump all stacks and kill the process so the
+        # outer harness sees a crash named by these stacks, not a freeze
+        kwargs = {"file": _REAL_STDERR} if _REAL_STDERR is not None else {}
+        faulthandler.dump_traceback_later(timeout, exit=True, **kwargs)
+    try:
+        yield
+    finally:
+        if armed:
+            faulthandler.cancel_dump_traceback_later()
+
+
+# ---------------------------------------------------------------------------
+# leaked-process guard: the test that orphans runtime processes FAILS
+
+#: grace for asynchronous child teardown after a test's fixtures finish
+_LEAK_GRACE_S = 5.0
+
+
+def _wait_for_drain(candidates, grace_s):
+    import time as _time
+
+    deadline = _time.monotonic() + grace_s
+    live = [p for p in candidates if pid_alive(p)]
+    while live and _time.monotonic() < deadline:
+        _time.sleep(0.2)
+        live = [p for p in live if pid_alive(p)]
+    return live
+
+
+def _our_runtime_pids():
+    """Runtime processes belonging to clusters THIS pytest process
+    spawned (RAY_TPU_SPAWNER_PID stamp): a sibling session's (or a dev's
+    detached) cluster must never be flagged or reaped by these guards."""
+    return find_runtime_pids(spawner_pid=os.getpid())
+
+
+@pytest.fixture(autouse=True)
+def _runtime_leak_guard(request):
+    before = set(_our_runtime_pids())
+    yield
+    if ray_tpu.is_initialized():
+        # a module/session-scoped cluster is legitimately still up; its
+        # processes are accounted for when that fixture finalizes
+        return
+    leaked = _wait_for_drain(set(_our_runtime_pids()) - before, _LEAK_GRACE_S)
+    if leaked:
+        details = []
+        for pid in leaked:
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\x00", b" ").decode(errors="replace").strip()
+            except OSError:
+                cmd = "?"
+            details.append(f"pid {pid}: {cmd}")
+        reap_all(leaked)  # don't poison the rest of the suite
+        pytest.fail(
+            "test leaked runtime processes (reaped):\n  " + "\n  ".join(details),
+            pytrace=False,
+        )
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _session_process_sweep():
+    """Backstop for leaks that escape per-test attribution (module-scoped
+    fixture teardown after the last test of a module): reap anything left
+    at session end so consecutive suite runs start clean. Scoped to OUR
+    spawner stamp — a concurrently running sibling pytest session's
+    clusters must never be reaped from here."""
+    yield
+    leftovers = _wait_for_drain(_our_runtime_pids(), _LEAK_GRACE_S)
+    if leftovers:
+        import warnings
+
+        reap_all(leftovers)
+        warnings.warn(
+            f"session ended with leaked runtime processes (reaped): {sorted(leftovers)}",
+            stacklevel=1,
+        )

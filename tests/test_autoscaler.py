@@ -21,10 +21,20 @@ from ray_tpu.cluster_utils import Cluster
 
 @pytest.fixture()
 def small_cluster():
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    # Deflake (round-5 verdict: order/timing-flaky on a loaded 1-vCPU
+    # box): autoscaled node boot can exceed the default 30s infeasible
+    # patience when the suite has the machine saturated — the task then
+    # fails terminally moments before its node joins. Raise the patience
+    # BEFORE Cluster() so it serializes into every spawned process too.
+    old_patience = GLOBAL_CONFIG.infeasible_fail_after_s
+    GLOBAL_CONFIG.infeasible_fail_after_s = 90.0
     cluster = Cluster(num_cpus=1)
     ray_tpu.init(address=cluster.address)
     provider = FakeMultiNodeProvider(f"127.0.0.1:{cluster.controller_port}")
     yield cluster, provider
+    GLOBAL_CONFIG.infeasible_fail_after_s = old_patience  # before any teardown raise
     try:
         provider.shutdown()
     finally:
@@ -124,14 +134,23 @@ def test_tpu_slice_launches_atomically(small_cluster):
         def on_slice():
             return "ok"
 
-        assert ray_tpu.get(on_slice.remote(), timeout=90) == "ok"
-        nodes = provider.non_terminated_nodes()
-        assert len(nodes) == 2, nodes  # both slice hosts
+        assert ray_tpu.get(on_slice.remote(), timeout=120) == "ok"
+        # both hosts exist as provider records the moment the single
+        # create_node returns — but assert with a grace window rather
+        # than instantaneously (the second host's spawn can still be
+        # mid-boot on a saturated box, and an autoscaler pass may be
+        # in flight)
+        _wait(
+            lambda: len(provider.non_terminated_nodes()) == 2,
+            timeout=30,
+            msg=f"atomic slice launch: {provider.non_terminated_nodes()}",
+        )
+        assert len(provider.non_terminated_nodes()) == 2  # and never more
         _wait(
             lambda: sum(
                 1 for n in ray_tpu.nodes() if n["Alive"]
             ) >= 3,
-            timeout=30,
+            timeout=60,
             msg="both slice hosts join the cluster",
         )
     finally:

@@ -167,3 +167,32 @@ def test_nested_refs_in_value(ray_start_local):
 def test_cluster_resources(ray_start_local):
     res = ray_tpu.cluster_resources()
     assert res["CPU"] > 0
+
+
+def test_streaming_actor_method_local_mode(ray_start_local):
+    """Regression (round-5 advisor): streaming actor methods used to
+    block forever in local mode — submit_actor_task had no streaming
+    branch, so the generator's stream was never fed."""
+
+    @ray_tpu.remote
+    class Gen:
+        def __init__(self):
+            self.base = 100
+
+        def stream(self, n):
+            for i in range(n):
+                yield self.base + i
+
+        def boom(self):
+            yield 1
+            raise RuntimeError("mid-stream")
+
+    g = Gen.remote()
+    gen = g.stream.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r) for r in gen] == [100, 101, 102]
+    # mid-stream errors surface instead of hanging
+    gen2 = g.boom.options(num_returns="streaming").remote()
+    it = iter(gen2)
+    assert ray_tpu.get(next(it)) == 1
+    with pytest.raises(Exception):
+        ray_tpu.get(next(it))

@@ -8,6 +8,8 @@ import pytest
 import ray_tpu
 from ray_tpu.rl import PPO, PPOConfig
 
+from conftest import multiprocess_cpu_collectives
+
 
 @pytest.fixture(scope="module")
 def cluster():
@@ -91,6 +93,7 @@ def test_ppo_state_roundtrip(cluster):
         algo.stop()
 
 
+@multiprocess_cpu_collectives
 def test_learner_group_matches_single_process(cluster):
     """A 2-process LearnerGroup update (one pjit program, batch sharded
     over the gang) must be numerically IDENTICAL to a single-process
@@ -204,6 +207,7 @@ def test_impala_state_roundtrip(cluster):
         algo.stop()
 
 
+@multiprocess_cpu_collectives
 def test_impala_with_learner_gang(cluster):
     """IMPALA over a 2-process LearnerGroup: the V-trace update ships to
     the gang as one pjit program (batch sharded over envs) and training

@@ -11,6 +11,8 @@ import ray_tpu
 from ray_tpu import train
 from ray_tpu.train import JaxBackendConfig, JaxTrainer, RunConfig, ScalingConfig
 
+from conftest import multiprocess_cpu_collectives
+
 
 @pytest.fixture(scope="module")
 def cluster():
@@ -47,6 +49,7 @@ def _dist_fn(config):
     )
 
 
+@multiprocess_cpu_collectives
 def test_two_process_jax_distributed(cluster, tmp_path):
     trainer = JaxTrainer(
         _dist_fn,
