@@ -58,6 +58,7 @@ __all__ = [
     "ObjectRef",
     "ActorHandle",
     "nodes",
+    "drain_node",
     "cluster_resources",
     "available_resources",
     "free",
@@ -137,6 +138,21 @@ def free(refs) -> None:
 
 def nodes():
     return _api._global_worker().backend.nodes()
+
+
+def drain_node(node_id, reason: str = "drain requested") -> bool:
+    """Gracefully drain a node (preemption-style): it leaves the
+    scheduling pool, finishes running work within the drain grace,
+    replicates its primary object copies off-node, and exits cleanly.
+    Actor restarts it causes consume no ``max_restarts`` budget.
+
+    ``node_id``: hex string (as in ``nodes()[i]["NodeID"]``) or bytes.
+    """
+    if isinstance(node_id, str):
+        node_id = bytes.fromhex(node_id)
+    elif isinstance(node_id, NodeID):
+        node_id = node_id.binary()
+    return _api._global_worker().backend.drain_node(node_id, reason)
 
 
 def cluster_resources():

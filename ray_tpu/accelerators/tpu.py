@@ -107,6 +107,29 @@ def _fetch_metadata(path: str) -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# Preemption / maintenance-event probe (pluggable via set_metadata_fetcher)
+
+#: metadata path GCE flips from NONE before host maintenance / preemption
+MAINTENANCE_EVENT_PATH = "maintenance-event"
+
+
+def get_current_node_maintenance_event() -> Optional[str]:
+    """The pending maintenance event for this host (e.g. ``"TERMINATE_ON_
+    HOST_MAINTENANCE"``), ``"NONE"``/None when nothing is scheduled. Uses
+    the same injectable metadata fetcher as the rest of TPU detection, so
+    tests and non-GCE deployments plug in their own preemption signal."""
+    event = _fetch_metadata(MAINTENANCE_EVENT_PATH)
+    return event.strip() if event else None
+
+
+def maintenance_event_imminent() -> bool:
+    """True when the platform has announced this host will be reclaimed —
+    the node daemon's preemption-probe loop turns this into a drain."""
+    event = get_current_node_maintenance_event()
+    return bool(event) and event.upper() != "NONE"
+
+
+# ---------------------------------------------------------------------------
 # Pod-type math
 
 

@@ -85,15 +85,29 @@ class StandardAutoscaler:
             + list(snap["pending_actors"])
             + list(snap["pending_bundles"])
         )
+        # Preemption-aware replacement: a DRAINING node's workload must
+        # land somewhere else BEFORE the kill — count each draining
+        # node's full capacity as demand so the replacement launches the
+        # moment the warning arrives, not after the node dies and its
+        # work re-queues (arXiv:2605.25645: replacement lead time
+        # dominates effective goodput on spot slices).
+        draining = [
+            n for n in snap["nodes"] if n["alive"] and n.get("state") == "DRAINING"
+        ]
+        shapes.extend(dict(n["total"]) for n in draining)
         provider_nodes = self._provider.non_terminated_nodes()
         # SLICES are the unit: group host records by launch group
         groups: Dict[str, List[Dict[str, Any]]] = {}
         for r in provider_nodes:
             groups.setdefault(r.get("group", r["id"]), []).append(r)
 
-        # 2. live spare capacity absorbs demand first (per-node fitting)
+        # 2. live spare capacity absorbs demand first (per-node fitting).
+        # Draining nodes contribute NO spare capacity: nothing new may be
+        # packed onto a node that is about to disappear.
         spare: List[Dict[str, float]] = [
-            dict(n["available"]) for n in snap["nodes"] if n["alive"]
+            dict(n["available"])
+            for n in snap["nodes"]
+            if n["alive"] and n.get("state") != "DRAINING"
         ]
         unmet: List[Dict[str, float]] = []
         for shape in sorted(shapes, key=lambda s: -sum(s.values())):
@@ -108,9 +122,17 @@ class StandardAutoscaler:
 
         # 3. pack unmet demand onto node types; launch. Counting is per
         # SLICE (launch group), not per host — max_workers bounds slices.
+        # Groups whose every host is draining don't count against the
+        # caps: their replacement must be launchable NOW, not after the
+        # preempted slice finally dies and frees its slot.
+        draining_ids = {n["node_id"] for n in draining}
         launches: List[NodeTypeConfig] = []
         counts: Dict[str, int] = {}
+        active_groups = 0
         for grp in groups.values():
+            if all(r.get("node_id_hex") in draining_ids for r in grp):
+                continue
+            active_groups += 1
             counts[grp[0]["node_type"]] = counts.get(grp[0]["node_type"], 0) + 1
         # Booting supply credit (reference resource_demand_scheduler's
         # "upcoming nodes"): provider nodes not yet in the controller
@@ -144,7 +166,7 @@ class StandardAutoscaler:
                     break
             if placed:
                 continue
-            nt = self._pick_type(shape, counts, len(groups) + len(launches))
+            nt = self._pick_type(shape, counts, active_groups + len(launches))
             if nt is None:
                 logger.warning("demand %s unschedulable on any node type", shape)
                 continue

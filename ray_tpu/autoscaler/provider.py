@@ -84,6 +84,18 @@ class FakeMultiNodeProvider(NodeProvider):
             rec = self._nodes.pop(provider_id, None)
         if rec is None:
             return
+        # SIGINT first: scale-down is an INTENTIONAL termination, not a
+        # preemption — letting the SIGTERM drain protocol run would
+        # self-report DRAINING, which the autoscaler counts as unmet
+        # demand and replaces (terminate → replace → idle → terminate
+        # oscillation). Same teardown-vs-drain split as Cluster.shutdown.
+        import os
+        import signal
+
+        try:
+            os.kill(rec["proc"].pid, signal.SIGINT)
+        except OSError:
+            pass
         # escalating group reap (util/reaper.py): the daemon AND its
         # workers go down, bounded, even if SIGTERM is ignored
         from ray_tpu.util.reaper import reap_process

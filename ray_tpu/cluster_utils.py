@@ -85,8 +85,19 @@ class Cluster:
         shared SIGTERM grace across node groups + the head group, SIGKILL
         survivors (util/reaper.py). Bounded — a SIGTERM-ignoring daemon
         cannot wedge the test that owns this cluster."""
+        import signal as _signal
+
         from ray_tpu.util.reaper import reap_all
 
+        # SIGINT first: driver-initiated teardown means "cluster over",
+        # not preemption — node daemons must stop immediately instead of
+        # entering the SIGTERM drain protocol (self-report, actor grace,
+        # object flush against peers that are dying too)
+        for proc in self.nodes:
+            try:
+                os.kill(proc.pid, _signal.SIGINT)
+            except OSError:
+                pass
         leaked = reap_all(list(self.nodes) + [self._head], group=True)
         if leaked:
             import logging

@@ -151,7 +151,17 @@ def _stop(proc: subprocess.Popen) -> None:
     (head/node daemons run with start_new_session=True and own their
     workers' group): SIGTERM → grace → SIGKILL, always bounded. The group
     kill is what prevents the round-5 "orphaned head_main" leak class —
-    terminating only the leader leaves its children reparented to init."""
+    terminating only the leader leaves its children reparented to init.
+
+    SIGINT precedes the reap: driver-initiated teardown is "cluster
+    over", not a preemption warning — daemons must exit now, not run the
+    SIGTERM drain protocol."""
+    import signal
+
     from ray_tpu.util.reaper import reap_process
 
+    try:
+        os.kill(proc.pid, signal.SIGINT)
+    except OSError:
+        pass
     reap_process(proc, group=True)

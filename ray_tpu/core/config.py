@@ -141,6 +141,22 @@ class GlobalConfig:
     reap_term_grace_s: float = 2.0
     reap_kill_grace_s: float = 3.0
 
+    # --- node drain / preemption (core/node_daemon.py, controller) ---
+    #: how long a draining node lets running tasks finish (and library
+    #: controllers migrate actors) before it flushes objects and exits
+    drain_grace_s: float = 30.0
+    #: treat SIGTERM to a worker-node daemon as a preemption warning:
+    #: self-report drain, run the grace, exit cleanly — instead of
+    #: stopping abruptly (spot/maintenance reclaims deliver SIGTERM)
+    drain_on_sigterm: bool = True
+    #: >0: poll the accelerator maintenance-event probe this often and
+    #: self-drain when an event is imminent (0 disables; the probe is
+    #: pluggable via accelerators.tpu.set_metadata_fetcher)
+    preemption_probe_period_s: float = 0.0
+    #: replicate primary shm object copies to a peer node during drain
+    #: so consumers re-fetch instead of paying lineage reconstruction
+    drain_flush_objects: bool = True
+
     # --- RPC ---
     rpc_connect_timeout_s: float = 10.0
     rpc_retry_base_delay_s: float = 0.05

@@ -53,6 +53,10 @@ class NodeInfo:
     available: Dict[str, float]
     labels: Dict[str, str] = field(default_factory=dict)
     alive: bool = True
+    #: ALIVE | DRAINING | DEAD — DRAINING nodes are excluded from
+    #: scheduling/placement but still serve running work and objects
+    state: str = "ALIVE"
+    drain_reason: str = ""
     last_sync: float = field(default_factory=time.monotonic)
     health_failures: int = 0
 
@@ -99,6 +103,11 @@ class Controller:
         self.removed_pgs: "OrderedDict[bytes, None]" = OrderedDict()
         self.kv: Dict[bytes, bytes] = {}
         self.jobs: Dict[bytes, Dict[str, Any]] = {}
+        # Drain object-relocation directory: a draining daemon replicates
+        # its primary shm copies to a peer and records the new location
+        # here; owners whose cached locations go stale consult this before
+        # paying lineage reconstruction. Bounded ring.
+        self.relocated_objects: "OrderedDict[bytes, Tuple[bytes, str, int]]" = OrderedDict()
         # task-event ring buffer (``GcsTaskManager`` — serves the state
         # API's `list tasks`; workers push batched lifecycle events)
         self.task_events: "OrderedDict[bytes, Dict[str, Any]]" = OrderedDict()
@@ -436,6 +445,9 @@ class Controller:
                     {"actor_id": a["actor_id"], "state": "ALIVE", "address": info.address},
                 )
         return {
+            # DRAINING nodes are omitted: daemons use this view for
+            # spillback targets and data block placement — neither may
+            # land new work on a node about to disappear
             "view": [
                 {
                     "node_id": n.node_id,
@@ -447,7 +459,7 @@ class Controller:
                     "labels": n.labels,
                 }
                 for n in self.nodes.values()
-                if n.alive
+                if n.alive and n.state != "DRAINING"
             ]
         }
 
@@ -457,6 +469,8 @@ class Controller:
                 "NodeID": n.node_id.hex(),
                 "node_id": n.node_id,
                 "Alive": n.alive,
+                "State": n.state,
+                "DrainReason": n.drain_reason,
                 "Resources": n.total,
                 "Available": n.available,
                 "host": n.host,
@@ -491,6 +505,7 @@ class Controller:
                 {
                     "node_id": n.node_id.hex(),
                     "alive": n.alive,
+                    "state": n.state,
                     "total": n.total,
                     "available": n.available,
                     "labels": n.labels,
@@ -545,19 +560,95 @@ class Controller:
     async def _mark_node_dead(self, node: NodeInfo, reason: str) -> None:
         if not node.alive:
             return
+        drained = node.state == "DRAINING"
         node.alive = False
+        node.state = "DEAD"
         logger.warning("node %s dead: %s", node.node_id.hex()[:8], reason)
-        await self._publish(NODE_PUSH_CHANNEL, {"node_id": node.node_id, "alive": False})
-        # Fail over actors that lived there.
+        await self._publish(
+            NODE_PUSH_CHANNEL,
+            {"node_id": node.node_id, "alive": False, "state": "DEAD"},
+        )
+        # Fail over actors that lived there. A drained node's deaths are
+        # not the actors' fault: their restarts consume no budget.
         for actor_id, info in list(self.actors.items()):
             if info.node_id == node.node_id and info.state in ("ALIVE", "PENDING", "RESTARTING"):
-                await self._handle_actor_death(actor_id, f"node died: {reason}")
+                await self._handle_actor_death(
+                    actor_id, f"node died: {reason}", drained=drained
+                )
 
     async def c_drain_node(self, payload, conn):
+        """Enter the drain protocol (reference ``DrainNode`` in GCS): the
+        node leaves the scheduling pool but keeps serving running work and
+        objects until its daemon deregisters (or dies). Called by the
+        daemon itself on a preemption warning, or by operators/tests."""
         node = self.nodes.get(payload["node_id"])
-        if node is not None:
-            await self._mark_node_dead(node, "drained")
+        if node is None:
+            return {"ok": False}
+        if node.alive and node.state != "DRAINING":
+            node.state = "DRAINING"
+            node.drain_reason = payload.get("reason", "drain requested")
+            logger.warning(
+                "node %s draining: %s", node.node_id.hex()[:8], node.drain_reason
+            )
+            await self._publish(
+                NODE_PUSH_CHANNEL,
+                {
+                    "node_id": node.node_id,
+                    "alive": True,
+                    "state": "DRAINING",
+                    "reason": node.drain_reason,
+                },
+            )
+            # operator/test-initiated drains must reach the daemon too
+            # (the daemon's own self-report path makes this a no-op there)
+            client = self.node_clients.get(node.node_id)
+            if client is not None:
+                async def _forward():
+                    try:
+                        await client.call(
+                            "drain", {"reason": node.drain_reason}, timeout=10
+                        )
+                    except Exception:
+                        pass  # daemon already draining or gone
+
+                asyncio.ensure_future(_forward())
+        return {"ok": True}
+
+    async def c_deregister_node(self, payload, conn):
+        """Clean exit at the end of a drain: the node's entry goes DEAD
+        immediately (no ghost DRAINING rows, no health-check wait) and its
+        remaining actors fail over budget-free."""
+        node = self.nodes.get(payload["node_id"])
+        if node is None:
+            return {"ok": False}
+        await self._mark_node_dead(node, payload.get("reason", "drained (deregistered)"))
+        client = self.node_clients.pop(node.node_id, None)
+        if client is not None:
+            try:
+                await client.close()
+            except Exception:
+                pass
+        return {"ok": True}
+
+    # ---- drain object-relocation directory -----------------------------
+    async def c_report_relocated(self, payload, conn):
+        """Draining daemon reports shm objects it replicated to a peer:
+        {moves: [{object_id, node_id, host, port}]}. Owners consult this
+        (``get_relocated``) when their cached locations go stale."""
+        for m in payload["moves"]:
+            self.relocated_objects[m["object_id"]] = (
+                m["node_id"], m["host"], m["port"],
+            )
+            self.relocated_objects.move_to_end(m["object_id"])
+        while len(self.relocated_objects) > 65536:
+            self.relocated_objects.popitem(last=False)
         return True
+
+    async def c_get_relocated(self, payload, conn):
+        loc = self.relocated_objects.get(payload["object_id"])
+        if loc is None:
+            return None
+        return {"node_id": loc[0], "host": loc[1], "port": loc[2]}
 
     # ---- actors --------------------------------------------------------
     async def c_register_actor(self, payload, conn):
@@ -617,7 +708,10 @@ class Controller:
         )
 
     def _alive_nodes(self) -> List[NodeInfo]:
-        return [n for n in self.nodes.values() if n.alive]
+        """Nodes eligible for NEW work: alive and not draining. (Draining
+        nodes still serve running tasks/objects; they only leave the
+        scheduling pool.)"""
+        return [n for n in self.nodes.values() if n.alive and n.state != "DRAINING"]
 
     async def c_actor_ready(self, payload, conn):
         info = self.actors.get(payload["actor_id"])
@@ -641,22 +735,36 @@ class Controller:
         await self._handle_actor_death(payload["actor_id"], payload.get("reason", "worker died"))
         return {"ok": True}
 
-    async def _handle_actor_death(self, actor_id: ActorID, reason: str) -> None:
-        """The actor FSM restart edge (``gcs_actor_manager.h:548``)."""
+    async def _handle_actor_death(
+        self, actor_id: ActorID, reason: str, drained: bool = False
+    ) -> None:
+        """The actor FSM restart edge (``gcs_actor_manager.h:548``).
+
+        ``drained=True`` marks a death caused by a graceful node drain
+        (preemption): restartable actors (``max_restarts != 0``) restart
+        WITHOUT consuming budget — being preempted is not the actor's
+        failure. Actors with ``max_restarts=0`` still die normally (their
+        owners opted out of restarts; libraries like Train/Serve migrate
+        them at their own layer during the drain window)."""
         info = self.actors.get(actor_id)
         if info is None or info.state == "DEAD":
             return
         infinite = info.spec.max_restarts < 0  # -1 = restart forever
-        if (infinite or info.num_restarts < info.spec.max_restarts) and not self._stopping:
-            info.num_restarts += 1
+        budget_free = drained and info.spec.max_restarts != 0
+        if (
+            infinite or budget_free or info.num_restarts < info.spec.max_restarts
+        ) and not self._stopping:
+            if not budget_free:
+                info.num_restarts += 1
             info.state = "RESTARTING"
             info.address = None
             await self._publish(
                 ACTOR_PUSH_CHANNEL, {"actor_id": actor_id, "state": "RESTARTING"}
             )
             logger.info(
-                "restarting actor %s (%d/%d): %s",
-                actor_id.hex()[:8], info.num_restarts, info.spec.max_restarts, reason,
+                "restarting actor %s (%d/%d%s): %s",
+                actor_id.hex()[:8], info.num_restarts, info.spec.max_restarts,
+                " drained, budget-free" if budget_free else "", reason,
             )
             asyncio.ensure_future(self._schedule_actor(actor_id))
         else:

@@ -136,6 +136,8 @@ class CoreWorker(RuntimeBackend):
         # created for an in-flight item.
         self._streams: Dict[bytes, Any] = {}
         self._streams_lock = threading.Lock()
+        # node membership/drain event listeners (Train drain watch etc.)
+        self._node_event_listeners: List[Any] = []
         # borrowed refs observed ready via a status RPC: lets a
         # wait(timeout=0) poll answer from cache instead of paying the
         # borrowed-status grace window every call (bounded FIFO)
@@ -158,7 +160,8 @@ class CoreWorker(RuntimeBackend):
             self.daemon = RpcClient(daemon_host, daemon_port, name="noded")
             self.controller.subscribe_push(ACTOR_PUSH_CHANNEL, self._on_actor_push)
             self.controller.subscribe_push(PG_PUSH_CHANNEL, self._on_pg_push)
-            channels = [ACTOR_PUSH_CHANNEL, PG_PUSH_CHANNEL]
+            self.controller.subscribe_push(NODE_PUSH_CHANNEL, self._on_node_push)
+            channels = [ACTOR_PUSH_CHANNEL, PG_PUSH_CHANNEL, NODE_PUSH_CHANNEL]
             if executor is None and GLOBAL_CONFIG.log_to_driver:
                 # drivers print forwarded worker logs (reference
                 # LogMonitor → pubsub → driver stdout); workers never
@@ -344,12 +347,45 @@ class CoreWorker(RuntimeBackend):
                 "pull_object", {"object_id": oid.binary(), "sources": sources}, timeout=300
             )
         if meta is None:
+            # Stale locations can mean the holding node DRAINED and
+            # replicated its copies away — consult the controller's
+            # relocation directory before declaring the object lost
+            # (lineage reconstruction re-runs the producing task; a
+            # relocated copy costs one more pull).
+            moved = await self._fetch_relocated(oid)
+            if moved is not None:
+                meta = moved
+        if meta is None:
             raise ObjectLostError(oid, f"could not fetch from {locations}")
         buf = self.shm.read(oid, meta["size"])
         value = serialization.deserialize_bytes(buf)
         if self.refcounter.owns(oid):
             self.refcounter.add_location(oid, self._self_location())
         return value
+
+    async def _fetch_relocated(self, oid: ObjectID):
+        """Drain-relocation fallback: ask the controller where a drained
+        node replicated this object, pull from there. Returns local shm
+        meta or None. Updates the owner's location set so later readers
+        skip the detour."""
+        try:
+            loc = await self.controller.call(
+                "get_relocated", {"object_id": oid.binary()}, timeout=10
+            )
+        except Exception:
+            return None
+        if loc is None:
+            return None
+        meta = await self.daemon.call(
+            "pull_object",
+            {"object_id": oid.binary(), "sources": [(loc["host"], loc["port"])]},
+            timeout=300,
+        )
+        if meta is not None and self.refcounter.owns(oid):
+            self.refcounter.add_location(
+                oid, (loc["node_id"], loc["host"], loc["port"])
+            )
+        return meta
 
     # ------------------------------------------------------------------
     # wait — event-driven (reference ``raylet/wait_manager.h:25``): owned
@@ -1187,6 +1223,27 @@ class CoreWorker(RuntimeBackend):
                 st.creation_spec = None  # release pinned creation args
             st.event.set()
 
+    def _on_node_push(self, msg: Dict[str, Any]) -> None:
+        """Controller-pushed node membership/state changes. Libraries
+        (Train's drain watch, Serve) register listeners to react to
+        DRAINING the moment the warning lands, not on a poll interval."""
+        for cb in list(self._node_event_listeners):
+            try:
+                cb(msg)
+            except Exception:
+                logger.debug("node event listener failed", exc_info=True)
+
+    def add_node_event_listener(self, cb) -> None:
+        """``cb(msg)`` with msg = {node_id, alive, state?, reason?}; runs
+        on the io loop thread — keep it non-blocking."""
+        self._node_event_listeners.append(cb)
+
+    def remove_node_event_listener(self, cb) -> None:
+        try:
+            self._node_event_listeners.remove(cb)
+        except ValueError:
+            pass
+
     def _on_log_push(self, msg: Dict[str, Any]) -> None:
         import sys
 
@@ -1654,6 +1711,15 @@ class CoreWorker(RuntimeBackend):
 
     def nodes(self) -> List[Dict[str, Any]]:
         return self.io.run(self.controller.call("nodes"))
+
+    def drain_node(self, node_id: bytes, reason: str = "drain requested") -> bool:
+        """Operator-initiated graceful drain (reference ``DrainNode``)."""
+        reply = self.io.run(
+            self.controller.call(
+                "drain_node", {"node_id": node_id, "reason": reason}, timeout=30
+            )
+        )
+        return bool(reply and reply.get("ok"))
 
     # ------------------------------------------------------------------
     # owner services (every process with a CoreWorker serves these)

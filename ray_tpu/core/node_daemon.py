@@ -142,6 +142,12 @@ class NodeDaemon:
         self._waiting_seq = 0
         self._last_oom_check = 0.0
         self._stopping = False
+        # drain protocol state (graceful preemption; see drain())
+        self._draining = False
+        self._drain_task: Optional[asyncio.Task] = None
+        #: hook the hosting process installs (node_main) so a completed
+        #: drain exits the process; None for in-process daemons (tests)
+        self.on_drained = None
         for name in [m for m in dir(self) if m.startswith("d_")]:
             self.server.register(name[2:], getattr(self, name))
 
@@ -163,7 +169,160 @@ class NodeDaemon:
         # first wave of leases skips cold-start latency.
         for _ in range(GLOBAL_CONFIG.num_initial_workers):
             self._spawn_worker()
+        if GLOBAL_CONFIG.preemption_probe_period_s > 0:
+            self._tasks.append(asyncio.ensure_future(self._preemption_probe_loop()))
         return port
+
+    # ---- drain protocol (graceful preemption) --------------------------
+    async def _preemption_probe_loop(self) -> None:
+        """Poll the pluggable maintenance-event probe (GCE metadata by
+        default, injectable via accelerators.tpu.set_metadata_fetcher);
+        an imminent event self-initiates drain — the SIGTERM-less half of
+        preemption detection (host maintenance warns via metadata first)."""
+        from ray_tpu.accelerators.tpu import maintenance_event_imminent
+
+        loop = asyncio.get_event_loop()
+        while not self._stopping and not self._draining:
+            await asyncio.sleep(GLOBAL_CONFIG.preemption_probe_period_s)
+            try:
+                # the probe does blocking I/O (metadata HTTP) — keep it
+                # off the daemon's event loop
+                imminent = await loop.run_in_executor(None, maintenance_event_imminent)
+            except Exception:
+                continue
+            if imminent:
+                self.start_drain("maintenance event imminent")
+                return
+
+    def start_drain(self, reason: str) -> None:
+        """Idempotently kick off the drain sequence (callable from signal
+        handlers, the probe loop, and the ``drain`` RPC)."""
+        if self._draining or self._stopping:
+            return
+        self._draining = True
+        logger.warning("node %s draining: %s", self.node_id.hex()[:8], reason)
+        # wake parked lease requests so they re-evaluate → spillback away
+        self._notify_capacity()
+        self._drain_task = asyncio.ensure_future(self._drain(reason))
+
+    async def d_drain(self, payload, conn):
+        """Drain RPC (reference GCS ``DrainNode`` delivered to the
+        raylet): stop accepting work, finish what's running within the
+        grace, replicate primary object copies off-node, exit cleanly."""
+        self.start_drain(payload.get("reason", "drain RPC"))
+        return {"ok": True, "draining": True}
+
+    async def _drain(self, reason: str) -> None:
+        from ray_tpu.core.deadline import Deadline
+
+        deadline = Deadline.after(GLOBAL_CONFIG.drain_grace_s)
+        # 1. self-report: the controller pulls us from the scheduling pool
+        #    and pushes the DRAINING event to subscribed drivers/libraries
+        try:
+            await self.controller.call(
+                "drain_node",
+                {"node_id": self.node_id.binary(), "reason": reason},
+                timeout=5,
+            )
+        except Exception:
+            logger.warning("drain self-report failed", exc_info=True)
+        # 2. let running work finish: leases (tasks) drain by completing;
+        #    actors drain when their library controller migrates/kills
+        #    them (Serve unroutes, Train checkpoints then fails over on
+        #    node death). Poll — both counts only shrink now.
+        while not deadline.expired and not self._stopping:
+            busy_actors = sum(1 for w in self.workers.values() if w.actor_id is not None)
+            if not self.leases and not busy_actors:
+                break
+            await asyncio.sleep(0.1)
+        if self.leases:
+            logger.warning(
+                "drain grace expired with %d lease(s) still running — "
+                "falling back to abrupt teardown", len(self.leases),
+            )
+        # 3. replicate primary shm copies to a peer so consumers re-fetch
+        #    instead of paying lineage reconstruction (bounded by the
+        #    remaining grace; best-effort)
+        if GLOBAL_CONFIG.drain_flush_objects and not self._stopping:
+            try:
+                await self._flush_objects(deadline)
+            except Exception:
+                logger.warning("drain object flush failed", exc_info=True)
+        # 4. deregister: the controller fails our remaining actors over
+        #    budget-free NOW instead of waiting out the health checker
+        try:
+            await self.controller.call(
+                "deregister_node",
+                {"node_id": self.node_id.binary(), "reason": f"drained: {reason}"},
+                timeout=5,
+            )
+        except Exception:
+            logger.warning("drain deregister failed", exc_info=True)
+        logger.info("drain complete (%s)", reason)
+        if self.on_drained is not None:
+            try:
+                self.on_drained()
+            except Exception:
+                pass
+
+    async def _flush_objects(self, deadline) -> None:
+        """Ask a live peer daemon to pull every local primary copy, then
+        record the relocations with the controller (the owner-side fetch
+        fallback consults that directory when our copies vanish)."""
+        peers = [
+            n for n in self._view if n.node_id != self.node_id.binary()
+        ]
+        if not peers:
+            return
+        # primaries only: transfer-received replicas already live on
+        # their source node — re-replicating them burns the bounded grace
+        # and pollutes the relocation ring for no added durability
+        entries = [e for e in self.store.list_entries() if e.get("primary", True)]
+        if not entries:
+            return
+        moves: List[Dict[str, Any]] = []
+        for i, entry in enumerate(entries):
+            if deadline.expired or self._stopping:
+                logger.warning(
+                    "drain flush ran out of grace: %d/%d objects replicated",
+                    len(moves), len(entries),
+                )
+                break
+            peer = peers[i % len(peers)]
+            object_id = bytes.fromhex(entry["object_id"])  # list_entries is hex
+            try:
+                meta = await self._peer(peer.host, peer.port).call(
+                    "pull_object",
+                    {
+                        "object_id": object_id,
+                        "sources": [(self.host, self.port)],
+                    },
+                    timeout=max(1.0, min(60.0, deadline.remaining())),
+                )
+            except Exception:
+                logger.warning(
+                    "drain flush of %s to %s:%s failed",
+                    object_id.hex()[:12], peer.host, peer.port, exc_info=True,
+                )
+                continue
+            if meta is not None:
+                moves.append(
+                    {
+                        "object_id": object_id,
+                        "node_id": peer.node_id,
+                        "host": peer.host,
+                        "port": peer.port,
+                    }
+                )
+                # the peer holds the replica now: stop claiming the
+                # object so our shutdown doesn't unlink the (possibly
+                # shared-inode) segment out from under it
+                self.store.forget(ObjectID(object_id))
+        if moves:
+            await self.controller.call(
+                "report_relocated", {"moves": moves}, timeout=10
+            )
+            logger.info("drain: replicated %d object(s) off-node", len(moves))
 
     # ---- memory monitor (OOM killer) -----------------------------------
     @staticmethod
@@ -283,6 +442,8 @@ class NodeDaemon:
             self._metrics_server.stop()
         for t in self._tasks:
             t.cancel()
+        if self._drain_task is not None:
+            self._drain_task.cancel()
         # Escalating reap of every child we spawned (hang defense): one
         # shared SIGTERM grace for the whole pool, SIGKILL the survivors —
         # a worker ignoring SIGTERM (stuck in native code, masked signal)
@@ -696,6 +857,13 @@ class NodeDaemon:
 
     async def _try_lease(self, request: Dict[str, float], strategy):
         """One grant attempt: dict reply, or None = queue and retry."""
+        # Draining: no NEW leases land here — spill to a live peer (or
+        # report infeasible so the client's retry window + autoscaler
+        # replacement take over). PG-bundle leases are exempt: a committed
+        # bundle exists only on this node, refusing would wedge the gang.
+        if self._draining and not isinstance(strategy, PlacementGroupScheduling):
+            reply = self._spillback_or_retry(request, strategy)
+            return None if "retry_after" in reply else reply
         # Placement-group leases consume from the bundle pool.
         bundle_key = None
         if isinstance(strategy, PlacementGroupScheduling):
@@ -863,6 +1031,9 @@ class NodeDaemon:
 
     # ---- actors --------------------------------------------------------
     async def d_start_actor(self, payload, conn):
+        if self._draining:
+            # races the controller's DRAINING exclusion: reschedule
+            raise RuntimeError("node is draining; cannot host new actors")
         spec: TaskSpec = payload["spec"]
         req = ResourceSet(spec.resources)
         bundle_key = None
@@ -921,6 +1092,8 @@ class NodeDaemon:
 
     # ---- placement group bundles (2PC) --------------------------------
     async def d_prepare_bundle(self, payload, conn):
+        if self._draining:
+            raise RuntimeError("node is draining; cannot reserve bundles")
         key = (payload["pg_id"], payload["bundle_index"])
         req = ResourceSet(payload["resources"])
         if key in self._prepared_bundles or key in self._bundle_pools:

@@ -39,8 +39,21 @@ async def amain(args) -> None:
 
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        loop.add_signal_handler(sig, stop.set)
+    # SIGTERM is a preemption WARNING (spot reclaims / maintenance events
+    # deliver it before the kill): enter the drain protocol — self-report
+    # DRAINING, finish running work within the grace, replicate objects
+    # off-node, deregister — then exit. The drain completes immediately
+    # on an idle node, so routine teardown stays fast; an escalating
+    # reaper's SIGKILL still bounds a slow drain. SIGINT stops abruptly.
+    daemon.on_drained = stop.set
+    if GLOBAL_CONFIG.drain_on_sigterm:
+        loop.add_signal_handler(
+            signal.SIGTERM,
+            lambda: daemon.start_drain("SIGTERM (preemption warning)"),
+        )
+    else:
+        loop.add_signal_handler(signal.SIGTERM, stop.set)
+    loop.add_signal_handler(signal.SIGINT, stop.set)
     # see head_main: driver-owned nodes exit when their spawner dies
     from ray_tpu.util.reaper import start_orphan_watch
 

@@ -128,6 +128,11 @@ class _Entry:
     # process ever attached can be renamed+rewritten by its creator with
     # warm pages; one that was read may back live zero-copy views.
     read_by_any: bool = False
+    # True for copies CREATED on this node (worker put/task output via
+    # adopt); False for transfer-received replicas (create_with_data).
+    # The drain flush replicates only primaries — secondaries already
+    # live elsewhere.
+    primary: bool = True
 
 
 class ShmStore:
@@ -170,6 +175,7 @@ class ShmStore:
                     "in_shm": e.in_shm,
                     "pinned": e.pinned,
                     "spilled": e.spilled_path is not None,
+                    "primary": e.primary,
                 }
                 for oid, e in self._entries.items()
             ]
@@ -211,7 +217,7 @@ class ShmStore:
                 # machine's /dev/shm, so the segment already exists with
                 # identical content (objects are immutable) — adopt as-is.
                 pass
-            self._entries[object_id] = _Entry(size=size)
+            self._entries[object_id] = _Entry(size=size, primary=False)
             self._used += size
 
     def _recycle_pool_debt(self) -> int:
@@ -359,6 +365,25 @@ class ShmStore:
                     return True
             self._drop(object_id)
             return False
+
+    def forget(self, object_id: ObjectID) -> None:
+        """Drop the entry WITHOUT unlinking the segment. Drain handoff:
+        once a peer holds the replica, this store must stop claiming the
+        object — but on a simulated (shared-/dev/shm) cluster the peer's
+        "copy" is the SAME inode, so unlinking here (shutdown would)
+        destroys the replica too. A real preempted host dies seconds
+        later and takes the unreferenced inode with it."""
+        with self._lock:
+            e = self._entries.pop(object_id, None)
+            if e is None:
+                return
+            if e.in_shm:
+                self._used -= e.size
+            if e.spilled_path:
+                try:
+                    os.remove(e.spilled_path)
+                except OSError:
+                    pass
 
     def _drop(self, object_id: ObjectID) -> None:
         e = self._entries.pop(object_id, None)

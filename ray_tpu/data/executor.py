@@ -211,16 +211,47 @@ class _PoolActorWrapper:
         return apply_batched(self._fn, block, self._batch_size)
 
 
+def _ref_death_error(ref) -> Optional[Exception]:
+    """Owner-side peek: the worker/actor-death error a ref resolved to,
+    or None. No data fetch — the driver owns stage refs, so failure
+    state is local (ownership table)."""
+    try:
+        from ray_tpu.core.api import get_global_worker_or_none
+        from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
+        from ray_tpu.core.ownership import ObjState
+
+        w = get_global_worker_or_none()
+        rc = getattr(getattr(w, "backend", None), "refcounter", None)
+        if rc is None or not rc.owns(ref.id()):
+            return None
+        obj = rc.get(ref.id())
+        if obj is not None and obj.state == ObjState.FAILED and isinstance(
+            obj.error, (ActorDiedError, WorkerCrashedError)
+        ):
+            return obj.error
+    except Exception:
+        return None
+    return None
+
+
 def execute_actor_stage(
     upstream: Iterator["ray_tpu.ObjectRef"],
     stage: ActorStage,
     *,
     per_actor_inflight: int = 2,
+    max_block_retries: int = 3,
 ) -> Iterator["ray_tpu.ObjectRef"]:
     """Stream upstream blocks through an autoscaling pool of stateful
     actors. The pool starts at ``min_size`` and grows (up to
     ``max_size``) whenever every actor is saturated and more input is
-    waiting; actors die with their handles when the stage completes."""
+    waiting; actors die with their handles when the stage completes.
+
+    Fault tolerance: a pool actor dying mid-block (preempted node, OOM
+    kill) fails every ref in flight on it — each such block is
+    resubmitted to a surviving (or freshly spawned) pool actor, up to
+    ``max_block_retries`` attempts per block, instead of failing the
+    stage. The input block ref is retained until its result is emitted,
+    so the retry re-reads the same upstream data."""
     strategy: ActorPoolStrategy = stage.strategy
     remote_cls = ray_tpu.remote(num_cpus=1)(_PoolActorWrapper)
 
@@ -232,6 +263,8 @@ def execute_actor_stage(
     pool = [spawn() for _ in range(strategy.min_size)]
     inflight: List[List[Any]] = [[] for _ in pool]  # per-actor pending refs
     out_order: List[Any] = []  # result refs in submission order
+    #: result ref -> (input block ref, pool index, attempts so far)
+    ref_meta: dict = {}
     bp = StoreBackpressure()
 
     def least_loaded() -> int:
@@ -241,6 +274,24 @@ def execute_actor_stage(
         for lst in inflight:
             while lst and ray_tpu.wait([lst[0]], num_returns=1, timeout=0)[0]:
                 lst.pop(0)
+
+    def submit(block_ref, attempts: int = 0):
+        i = least_loaded()
+        ref = pool[i].apply.remote(block_ref)
+        inflight[i].append(ref)
+        ref_meta[ref] = (block_ref, pool[i], attempts)
+        return ref
+
+    def recover(ref):
+        """Resubmit a death-failed result elsewhere; replace the corpse
+        in place (once — later failed refs from the same actor find it
+        already gone from the pool and simply resubmit)."""
+        block_ref, dead, attempts = ref_meta.pop(ref)
+        if dead in pool:
+            i = pool.index(dead)
+            pool[i] = spawn()
+            inflight[i] = []
+        return submit(block_ref, attempts + 1)
 
     upstream_iter = iter(upstream)
     exhausted = False
@@ -266,14 +317,21 @@ def execute_actor_stage(
             except StopIteration:
                 exhausted = True
                 break
-            ref = pool[i].apply.remote(block_ref)
-            inflight[i].append(ref)
-            out_order.append(ref)
+            out_order.append(submit(block_ref))
         if emitted < len(out_order):
             head = out_order[emitted]
+            while True:
+                ray_tpu.wait([head], num_returns=1, timeout=None, fetch_local=False)
+                err = _ref_death_error(head)
+                if err is None:
+                    break
+                _b, _a, attempts = ref_meta.get(head, (None, None, max_block_retries))
+                if attempts >= max_block_retries:
+                    break  # exhausted: the failure propagates to the consumer
+                head = recover(head)
+            ref_meta.pop(head, None)
             out_order[emitted] = None  # don't pin emitted blocks for the stage lifetime
             emitted += 1
-            ray_tpu.wait([head], num_returns=1, timeout=None, fetch_local=False)
             yield head
             continue
         if exhausted:

@@ -65,6 +65,20 @@ class _ServeController:
         self._registered_namespace = registered_namespace
         self._deployments: Dict[str, _DeploymentState] = {}
         self._lock = threading.Lock()
+        # Preemption-aware drain handoff: node ids currently DRAINING
+        # (controller-pushed). Replicas there are unrouted (moved to the
+        # draining list) so routers drop them, in-flight requests finish,
+        # and replacements start — all before the kill lands.
+        self._draining_nodes: set = set()
+        #: replica actor_id -> node_id cache (stable: replicas don't move)
+        self._replica_nodes: Dict[bytes, bytes] = {}
+        try:
+            from ray_tpu.core.api import _global_worker
+
+            self._node_listener_backend = _global_worker().backend
+            self._node_listener_backend.add_node_event_listener(self._on_node_event)
+        except Exception:
+            self._node_listener_backend = None  # local mode: no node events
         # serializes whole reconcile passes: deploy() (RPC thread) and the
         # control loop both reconcile, and unsynchronized passes would
         # double-start replicas then drop one set from tracking (leak)
@@ -82,6 +96,17 @@ class _ServeController:
         with self._change:
             self._versions[name] = self._versions.get(name, 0) + 1
             self._change.notify_all()
+
+    def _on_node_event(self, msg) -> None:
+        """Controller node-state push (io-loop thread: keep non-blocking).
+        DRAINING enters the set; DEAD/removed leaves it."""
+        node_id = msg.get("node_id")
+        if node_id is None:
+            return
+        if msg.get("state") == "DRAINING":
+            self._draining_nodes.add(node_id)
+        elif not msg.get("alive", True):
+            self._draining_nodes.discard(node_id)
 
     # -- API -------------------------------------------------------------
     def deploy(self, name, cls_or_fn, init_args, init_kwargs, config: DeploymentConfig) -> bool:
@@ -182,6 +207,54 @@ class _ServeController:
             version = self._versions.get(name, 0)
         return version, self._routing_set(name)
 
+    @ray_tpu.method(concurrency_group="longpoll")
+    def wait_status(
+        self,
+        name: str,
+        *,
+        min_replicas: Optional[int] = None,
+        max_replicas: Optional[int] = None,
+        quiescent: bool = False,
+        version: Optional[str] = None,
+        timeout_s: float = 30.0,
+    ):
+        """Condition-based status wait (deflakes what used to be client
+        sleep-polling): parks on the controller's change condition until
+        the deployment's routed-replica count enters
+        [min_replicas, max_replicas] (with ``quiescent``, nothing is
+        starting or draining; with ``version``, every routed replica is
+        on that version — a completed roll), or the timeout expires.
+        Returns the final status dict either way — callers assert on it."""
+        deadline = time.monotonic() + timeout_s
+
+        def _ok(st: Dict[str, Any]) -> bool:
+            if st is None:
+                return False
+            if min_replicas is not None and st["replicas"] < min_replicas:
+                return False
+            if max_replicas is not None and st["replicas"] > max_replicas:
+                return False
+            if quiescent and (st["starting"] or st["draining"]):
+                return False
+            if version is not None and (
+                st["version"] != version
+                or st["replicas_current_version"] != st["replicas"]
+            ):
+                return False
+            return True
+
+        while True:
+            st = self.status().get(name)
+            if _ok(st):
+                return st
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or self._stop.is_set():
+                return st
+            with self._change:
+                # woken by any routing-set change; the 0.25s cap also
+                # re-samples target/autoscale changes that don't bump
+                self._change.wait(min(remaining, 0.25))
+
     def routes(self) -> Dict[str, str]:
         """route_prefix -> deployment name (proxy routing table)."""
         with self._lock:
@@ -215,6 +288,13 @@ class _ServeController:
 
     def shutdown(self) -> bool:
         self._stop.set()
+        if self._node_listener_backend is not None:
+            try:
+                self._node_listener_backend.remove_node_event_listener(
+                    self._on_node_event
+                )
+            except Exception:
+                pass
         with self._lock:
             deployments = list(self._deployments.values())
             self._deployments.clear()
@@ -268,6 +348,21 @@ class _ServeController:
             )
         except Exception:
             return None
+
+    def _replica_node(self, handle) -> Optional[bytes]:
+        """Node hosting a replica (cached: replicas never migrate)."""
+        key = handle.actor_id
+        nid = self._replica_nodes.get(key)
+        if nid is not None:
+            return nid
+        info = self._core_actor_info(handle)
+        addr = (info or {}).get("address")
+        nid = getattr(addr, "node_id", None)
+        if nid is not None:
+            if len(self._replica_nodes) > 4096:  # replica-generation churn
+                self._replica_nodes.clear()
+            self._replica_nodes[key] = nid
+        return nid
 
     def _alive(self, replica) -> Optional[bool]:
         """True=alive, False=dead, None=slow (indeterminate)."""
@@ -324,6 +419,21 @@ class _ServeController:
                     else:
                         alive.append((v, r))
                 st.replicas = alive
+                # 2b. preemption handoff: replicas on DRAINING nodes are
+                # unrouted NOW (routers drop them on the next long-poll
+                # push, in-flight requests finish, the drain-kill waits
+                # for idle) and replacements start below — all inside the
+                # node's drain grace, so clients see zero errors.
+                if self._draining_nodes:
+                    still_routed: List[Tuple[str, Any]] = []
+                    for v, r in st.replicas:
+                        nid = self._replica_node(r)
+                        if nid is not None and nid in self._draining_nodes:
+                            st.draining.append((v, r, time.monotonic()))
+                            changed = True
+                        else:
+                            still_routed.append((v, r))
+                    st.replicas = still_routed
                 cur = st.version
                 ready_cur = [(v, r) for v, r in st.replicas if v == cur]
                 ready_old = [(v, r) for v, r in st.replicas if v != cur]

@@ -21,6 +21,7 @@ from ray_tpu.train.session import (
     get_context,
     get_dataset_shard,
     report,
+    urgent_checkpoint_requested,
 )
 from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
@@ -46,4 +47,5 @@ __all__ = [
     "get_context",
     "get_dataset_shard",
     "report",
+    "urgent_checkpoint_requested",
 ]

@@ -58,9 +58,16 @@ class _Session:
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
         self._report_seq = 0
+        # preemption warning: set when the trainer learns a node hosting
+        # this gang is DRAINING — the user loop checkpoints at its next
+        # step boundary instead of waiting for the periodic cadence
+        self.urgent_checkpoint = threading.Event()
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
         checkpoint = self._stage_checkpoint(checkpoint)
+        if checkpoint is not None:
+            # any checkpoint satisfies an outstanding urgent request
+            self.urgent_checkpoint.clear()
         self.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
 
     def _stage_checkpoint(self, checkpoint: Optional[Checkpoint]) -> Optional[Checkpoint]:
@@ -150,3 +157,12 @@ def get_dataset_shard(name: str = "train"):
 def get_checkpoint() -> Optional[Checkpoint]:
     """Checkpoint to resume from, if the group restarted after a failure."""
     return _get_session().context.checkpoint
+
+
+def urgent_checkpoint_requested() -> bool:
+    """True when a preemption warning landed (a node hosting this gang is
+    DRAINING): save a checkpoint with the next ``report()`` so the run
+    loses at most steps-since-warning instead of steps-since-the-last
+    periodic checkpoint. Cleared automatically once any checkpoint is
+    reported."""
+    return _get_session().urgent_checkpoint.is_set()

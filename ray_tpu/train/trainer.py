@@ -185,11 +185,103 @@ class JaxTrainer:
         """Drain worker report queues until every rank finishes.
 
         Reference: ``backend_executor.get_next_results`` — rank 0's metrics
-        win; any rank may attach the checkpoint (TPU SPMD: rank 0 saves)."""
+        win; any rank may attach the checkpoint (TPU SPMD: rank 0 saves).
+
+        Preemption awareness: subscribes to controller node-state pushes
+        for the run's duration; a node entering DRAINING triggers an
+        urgent-checkpoint request on every rank (SPMD: rank 0 saves), so
+        a preempted run loses at most steps-since-warning, not
+        steps-since-the-last periodic checkpoint."""
+        import threading
+
+        drained_nodes: set = set()
+        drain_lock = threading.Lock()
+        drain_seen = threading.Event()
+
+        def _on_node_event(msg: Dict[str, Any]) -> None:
+            if msg.get("state") == "DRAINING" and msg.get("node_id") is not None:
+                with drain_lock:
+                    drained_nodes.add(msg["node_id"])
+                drain_seen.set()
+
+        listener_backend = None
+        try:
+            from ray_tpu.core.api import _global_worker
+
+            listener_backend = _global_worker().backend
+            listener_backend.add_node_event_listener(_on_node_event)
+        except Exception:
+            listener_backend = None  # local mode: no node events
+        try:
+            return self._poll_loop_inner(
+                group, manager, drain_seen, drained_nodes, drain_lock,
+                listener_backend,
+            )
+        finally:
+            if listener_backend is not None:
+                try:
+                    listener_backend.remove_node_event_listener(_on_node_event)
+                except Exception:
+                    pass
+
+    @staticmethod
+    def _gang_node_ids(backend, group: WorkerGroup) -> set:
+        """Node ids currently hosting the gang's workers (actor table)."""
+        out = set()
+        for w in group.workers:
+            try:
+                info = backend.io.run(
+                    backend.controller.call(
+                        "get_actor_info", {"actor_id": w.actor_id}
+                    ),
+                    timeout=5,
+                )
+                nid = getattr((info or {}).get("address"), "node_id", None)
+                if nid is not None:
+                    out.add(nid)
+            except Exception:
+                pass
+        return out
+
+    def _poll_loop_inner(
+        self,
+        group: WorkerGroup,
+        manager: CheckpointManager,
+        drain_seen,
+        drained_nodes: set,
+        drain_lock,
+        listener_backend,
+    ) -> Result:
         last_metrics: Dict[str, Any] = {}
         history = []
         done = [False] * group.num_workers
         while not all(done):
+            if drain_seen.is_set():
+                drain_seen.clear()
+                with drain_lock:
+                    pending = set(drained_nodes)
+                    drained_nodes.clear()
+                # only a drain of a node HOSTING this gang warrants the
+                # checkpoint I/O — unrelated nodes (serve/data capacity)
+                # drain without interrupting training
+                gang_nodes = (
+                    self._gang_node_ids(listener_backend, group)
+                    if listener_backend is not None
+                    else set()
+                )
+                if pending & gang_nodes:
+                    # fire-and-forget: a rank already dying must not
+                    # stall the warning to the survivors (SPMD: every
+                    # rank flips its flag, rank 0 saves)
+                    for w in group.workers:
+                        try:
+                            w.request_urgent_checkpoint.remote()
+                        except Exception:
+                            pass
+                    logger.warning(
+                        "drain of a gang-hosting node detected — requested "
+                        "urgent checkpoint from all ranks"
+                    )
             try:
                 polls = group.execute("poll_results", timeout=60)
             except Exception as e:  # noqa: BLE001

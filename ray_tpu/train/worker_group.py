@@ -108,6 +108,15 @@ class TrainWorker:
         self._thread.start()
         return True
 
+    def request_urgent_checkpoint(self) -> bool:
+        """Preemption warning relay (trainer → session): the user loop
+        sees ``train.urgent_checkpoint_requested()`` flip and saves at
+        its next step boundary."""
+        if self._session is not None:
+            self._session.urgent_checkpoint.set()
+            return True
+        return False
+
     def poll_results(self) -> Dict[str, Any]:
         """Drain buffered ``report()`` calls; reference
         ``backend_executor.get_next_results``."""

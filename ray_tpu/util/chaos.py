@@ -82,6 +82,93 @@ class WorkerKiller:
         return self.kills
 
 
+class PreemptionKiller:
+    """Simulates a spot/maintenance preemption against a
+    ``cluster_utils.Cluster`` node: deliver the warning (SIGTERM to the
+    node daemon — which enters the drain protocol), wait ``grace_s``,
+    then SIGKILL the whole process group — exactly the contract real TPU
+    capacity gives you. A clean drain finishes before the grace and the
+    SIGKILL hits a corpse; a too-slow drain is cut off mid-flight, which
+    is the abrupt-death fallback path under test.
+
+    ``preempt(node)`` fires once synchronously; ``start()`` runs a
+    background loop preempting a random non-head node every
+    ``interval_s`` (replacing it when ``replace=True``)."""
+
+    def __init__(
+        self,
+        cluster,
+        *,
+        grace_s: float = 5.0,
+        interval_s: float = 5.0,
+        replace: bool = False,
+        node_resources: Optional[dict] = None,
+        num_cpus: float = 1,
+        max_kills: Optional[int] = None,
+        seed: Optional[int] = None,
+    ):
+        self.cluster = cluster
+        self.grace_s = grace_s
+        self.interval_s = interval_s
+        self.replace = replace
+        self.node_resources = node_resources
+        self.num_cpus = num_cpus
+        self.max_kills = max_kills
+        self.kills = 0
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def preempt(self, node) -> None:
+        """Warning now, SIGKILL after the grace. Blocks for ``grace_s``."""
+        try:
+            os.kill(node.pid, signal.SIGTERM)  # the preemption warning
+        except OSError:
+            return
+        deadline = time.monotonic() + self.grace_s
+        while time.monotonic() < deadline:
+            if node.poll() is not None:
+                break  # drained and exited before the axe fell
+            time.sleep(0.1)
+        try:
+            os.killpg(os.getpgid(node.pid), signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            node.wait(timeout=10)
+        except Exception:
+            pass
+        if node in self.cluster.nodes:
+            self.cluster.nodes.remove(node)
+        self.kills += 1
+
+    def start(self) -> "PreemptionKiller":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="chaos-preemption-killer"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self.max_kills is not None and self.kills >= self.max_kills:
+                return
+            nodes = list(self.cluster.nodes)
+            if not nodes:
+                continue
+            self.preempt(self._rng.choice(nodes))
+            if self.replace:
+                self.cluster.add_node(
+                    num_cpus=self.num_cpus, resources=self.node_resources
+                )
+
+    def stop(self) -> int:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        return self.kills
+
+
 class NodeKiller:
     """Periodically hard-kills a random non-head node of a
     ``cluster_utils.Cluster`` and (optionally) replaces it — the

@@ -166,13 +166,65 @@ def _our_runtime_pids():
     return find_runtime_pids(spawner_pid=os.getpid())
 
 
+def _daemon_reachable(host: str, port: int) -> bool:
+    import socket as _socket
+
+    try:
+        with _socket.create_connection((host, port), timeout=1.0):
+            return True
+    except OSError:
+        return False
+
+
+def _assert_no_ghost_draining_nodes():
+    """PR 2 drain invariant: a drain-exited daemon must have DEREGISTERED
+    from the controller — a node row stuck in DRAINING whose daemon
+    process is GONE is a protocol leak (the controller would neither
+    schedule on it nor fail its actors over). Checked while a shared
+    cluster is still up; a node mid-drain (daemon still reachable) is
+    legitimate and not flagged."""
+    try:
+        rows = ray_tpu.nodes()
+    except Exception:
+        return  # cluster mid-teardown: nothing to assert against
+    ghosts = []
+    for row in rows:
+        if row.get("State") != "DRAINING":
+            continue
+        import time as _time
+
+        # give an in-flight deregistration a moment to land
+        deadline = _time.monotonic() + _LEAK_GRACE_S
+        while _time.monotonic() < deadline:
+            try:
+                fresh = {n["NodeID"]: n for n in ray_tpu.nodes()}
+            except Exception:
+                return
+            cur = fresh.get(row["NodeID"])
+            if cur is None or cur.get("State") != "DRAINING":
+                break
+            if _daemon_reachable(cur["host"], cur["port"]):
+                break  # daemon alive: legitimately mid-drain, not a ghost
+            _time.sleep(0.2)
+        else:
+            ghosts.append(f"{row['NodeID'][:12]} ({row.get('DrainReason', '')})")
+    if ghosts:
+        pytest.fail(
+            "test left ghost DRAINING node entries (drain-exited daemons "
+            "must deregister):\n  " + "\n  ".join(ghosts),
+            pytrace=False,
+        )
+
+
 @pytest.fixture(autouse=True)
 def _runtime_leak_guard(request):
     before = set(_our_runtime_pids())
     yield
     if ray_tpu.is_initialized():
         # a module/session-scoped cluster is legitimately still up; its
-        # processes are accounted for when that fixture finalizes
+        # processes are accounted for when that fixture finalizes — but
+        # drain protocol state must still be clean between tests
+        _assert_no_ghost_draining_nodes()
         return
     leaked = _wait_for_drain(set(_our_runtime_pids()) - before, _LEAK_GRACE_S)
     if leaked:

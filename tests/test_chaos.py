@@ -16,13 +16,16 @@ from ray_tpu.cluster_utils import Cluster
 from ray_tpu.util.chaos import NodeKiller, WorkerKiller, find_worker_pids
 
 
-@pytest.fixture()
-def chaos_cluster(monkeypatch):
+@pytest.fixture(scope="module")
+def chaos_cluster():
     # inject retryable RPC failures into every daemon/worker the cluster
     # spawns (subprocess env inherits): 8% of task/actor pushes fail
     # with a transient (ChaosInjectedError) the submitters must retry.
-    # monkeypatch scopes the env var even if setup below raises.
-    monkeypatch.setenv("RAY_TPU_testing_rpc_failure", "push_batch:0.08")
+    # Module-scoped (suite wall-time): the chaos tests tolerate — are
+    # BUILT for — killed workers, so sharing one cluster is safe.
+    import os as _os
+
+    _os.environ["RAY_TPU_testing_rpc_failure"] = "push_batch:0.08"
     cluster = None
     try:
         cluster = Cluster(num_cpus=2)
@@ -34,7 +37,7 @@ def chaos_cluster(monkeypatch):
             cluster.shutdown()
         from ray_tpu.core.config import GLOBAL_CONFIG
 
-        monkeypatch.delenv("RAY_TPU_testing_rpc_failure", raising=False)
+        _os.environ.pop("RAY_TPU_testing_rpc_failure", None)
         GLOBAL_CONFIG.reset()
 
 
@@ -107,6 +110,10 @@ def test_actor_workload_under_worker_chaos(chaos_cluster):
     assert kills, "killer never fired — chaos was a no-op"
 
 
+# slow: the in-gate equivalent is test_drain.py::
+# test_preemption_mid_training_resumes_from_urgent_checkpoint (same
+# restart-from-checkpoint path, plus the drain protocol on top)
+@pytest.mark.slow
 def test_trainer_completes_under_node_chaos():
     """JaxTrainer + FailureConfig: training restarts from the latest
     checkpoint when the node hosting a train worker dies mid-run, and

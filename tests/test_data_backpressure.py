@@ -37,6 +37,7 @@ def test_oversized_dataset_streams_through_small_store(cluster):
     assert seen == list(range(n_blocks))
 
 
+@pytest.mark.slow
 def test_oversized_shuffle_streams_through_small_store(cluster):
     """The distributed shuffle exchange moves a store-oversized dataset
     entirely through tasks + the object store (driver holds refs only);

@@ -1,5 +1,8 @@
-"""Fault tolerance: node death detection + actor restart on a new node."""
+"""Fault tolerance: node death detection + actor restart on a new node,
+drain-vs-crash restart accounting, and pool-actor recovery in Data."""
 
+import os
+import signal
 import time
 
 import pytest
@@ -38,3 +41,103 @@ def test_node_death_actor_restart():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def _num_restarts(handle) -> int:
+    from ray_tpu.core.api import _global_worker
+
+    be = _global_worker().backend
+    info = be.io.run(
+        be.controller.call("get_actor_info", {"actor_id": handle.actor_id})
+    )
+    return info["num_restarts"]
+
+
+def test_drain_vs_crash_restart_accounting():
+    """The SAME actor failover path, two causes: a node CRASH consumes
+    max_restarts budget, a node DRAIN does not — preemption is not the
+    actor's failure (reference: DrainNode restarts are budget-exempt)."""
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    # short grace (see test_drain.py): a plain actor never exits on its
+    # own, so the drain otherwise waits the full 30s before deregistering
+    old_grace = GLOBAL_CONFIG.drain_grace_s
+    GLOBAL_CONFIG.drain_grace_s = 3.0
+    cluster = Cluster(num_cpus=1)
+    n_crash = cluster.add_node(num_cpus=1, resources={"crash": 1})
+    n_drain = cluster.add_node(num_cpus=1, resources={"drain": 1})
+    time.sleep(1.0)
+    ray_tpu.init(address=cluster.address)
+    try:
+
+        @ray_tpu.remote(max_restarts=2, max_task_retries=4, num_cpus=0)
+        class A:
+            def pid(self):
+                return os.getpid()
+
+            def node(self):
+                return ray_tpu.get_runtime_context().get_node_id()
+
+        a_crash = A.options(resources={"crash": 1}).remote()
+        a_drain = A.options(resources={"drain": 1}).remote()
+        ray_tpu.get([a_crash.pid.remote(), a_drain.pid.remote()], timeout=120)
+        drain_nid = ray_tpu.get(a_drain.node.remote(), timeout=60)
+        # replacement capacity for both actors
+        cluster.add_node(num_cpus=2, resources={"crash": 1, "drain": 1})
+        time.sleep(1.0)
+
+        # crash path: hard node kill
+        cluster.remove_node(n_crash)
+        # drain path: graceful preemption
+        assert ray_tpu.drain_node(drain_nid, "test: drain-vs-crash")
+
+        def recovered(handle):
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                try:
+                    return ray_tpu.get(handle.pid.remote(), timeout=15)
+                except ray_tpu.RayTpuError:
+                    time.sleep(1)
+            return None
+
+        assert recovered(a_crash) is not None
+        assert recovered(a_drain) is not None
+        assert _num_restarts(a_crash) == 1  # crash consumed budget
+        assert _num_restarts(a_drain) == 0  # drain did not
+    finally:
+        GLOBAL_CONFIG.drain_grace_s = old_grace
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_data_pool_actor_death_recovery(shutdown_only):
+    """A Data actor-pool stage survives its pool actors being SIGKILLed
+    mid-block: in-flight blocks resubmit to surviving/fresh actors and
+    the stage completes with every block intact."""
+    ray_tpu.init(num_cpus=4)
+    from ray_tpu.data.executor import (
+        ActorPoolStrategy,
+        ActorStage,
+        execute_actor_stage,
+        execute_streaming,
+    )
+
+    class PidDouble:
+        def __call__(self, block):
+            time.sleep(0.2)
+            return {"v": [x * 2 for x in block["v"]], "pid": [os.getpid()] * len(block["v"])}
+
+    sources = [(lambda i=i: {"v": [i]}) for i in range(10)]
+    upstream = execute_streaming(sources, [], max_inflight=10)
+    stage = ActorStage(PidDouble, (), {}, ActorPoolStrategy(2))
+    it = execute_actor_stage(upstream, stage)
+    first = ray_tpu.get(next(it), timeout=120)
+    # kill the worker that produced the first block — later in-flight
+    # blocks on that actor must be resubmitted, not failed
+    victim = int(first["pid"][0])
+    os.kill(victim, signal.SIGKILL)
+    rest = [ray_tpu.get(r, timeout=120) for r in it]
+    got = sorted(int(b["v"][0]) for b in [first] + rest)
+    assert got == [i * 2 for i in range(10)], got
+    # at least one surviving/replacement actor finished the tail
+    assert any(int(b["pid"][0]) != victim for b in rest)

@@ -33,11 +33,22 @@ def test_tpu_slice_bundles():
 
 @pytest.fixture(scope="module")
 def pg_cluster():
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    # Shorter scheduling deadline for this module (set BEFORE Cluster()
+    # so it serializes into the controller): node picking is instant when
+    # capacity exists — the deadline only gates how long INFEASIBLE
+    # verdicts take (test_pg_infeasible: 30s → 10s of pure waiting).
+    # Worker cold-boot is NOT under this deadline (start_actor returns at
+    # spawn), so feasible placements are unaffected.
+    old_lease = GLOBAL_CONFIG.worker_lease_timeout_s
+    GLOBAL_CONFIG.worker_lease_timeout_s = 10.0
     cluster = Cluster(num_cpus=2)
     cluster.add_node(num_cpus=2)
     time.sleep(1.0)
     ray_tpu.init(address=cluster.address)
     yield cluster
+    GLOBAL_CONFIG.worker_lease_timeout_s = old_lease
     ray_tpu.shutdown()
     cluster.shutdown()
 
@@ -101,6 +112,7 @@ def test_pg_table(pg_cluster):
     remove_placement_group(pg)
 
 
+@pytest.mark.slow
 def test_pg_churn_under_load(pg_cluster):
     """Create/remove many PGs while long tasks hold leased workers.
 

@@ -50,6 +50,7 @@ def test_gae_resets_at_done():
     assert adv[2, 0] == 11.0  # bootstraps from last_values
 
 
+@pytest.mark.slow
 def test_ppo_learns_cartpole(cluster):
     """Learning test: mean episode return must clearly improve within a
     small budget (reference rllib learning-test pattern)."""
@@ -158,6 +159,7 @@ def test_learner_group_matches_single_process(cluster):
     np.testing.assert_allclose(np.asarray(w1), np.asarray(w2), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_impala_learns_cartpole(cluster):
     """IMPALA learning test (reference rllib learning-test pattern):
     async V-trace updates must clearly improve the mean return."""
@@ -251,6 +253,7 @@ def test_replay_buffer_wraps_and_samples():
     assert s["x"].min() >= 150
 
 
+@pytest.mark.slow
 def test_dqn_learns_cartpole(cluster):
     """DQN learning test (reference rllib learning-test pattern):
     double-Q + replay must clearly improve the mean return."""
@@ -308,6 +311,7 @@ def test_dqn_state_roundtrip(cluster):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_dqn_cnn_on_image_env(cluster):
     """The image-obs path end to end: CNN Q-network + custom image env
     resolved by module path on the runner workers (Atari stand-in)."""

@@ -75,14 +75,18 @@ def test_replica_failure_recovery(cluster):
         handle._controller.get_replicas.remote("Flaky"), timeout=30
     )
     ray_tpu.kill(replicas[0])  # kill one replica
-    # 120s: replica respawn includes a fresh worker cold-start, which can
-    # take well over 60s on a box saturated by the full suite (this was
-    # an in-suite-only flake)
-    deadline = time.time() + 120
-    while time.time() < deadline:
-        if serve.status()["Flaky"]["replicas"] == 2:
-            break
-        time.sleep(0.5)
+    # condition-based wait (controller-side long-poll on its change
+    # condition) instead of client sleep-polling: returns the moment the
+    # replacement replica is routed. 120s budget: replica respawn
+    # includes a fresh worker cold-start, which can take well over 60s
+    # on a box saturated by the full suite.
+    st = ray_tpu.get(
+        handle._controller.wait_status.remote(
+            "Flaky", min_replicas=2, quiescent=True, timeout_s=120
+        ),
+        timeout=150,
+    )
+    assert st and st["replicas"] == 2, st
     # reconcile loop replaced the dead replica; traffic still flows.
     # Routing is at-most-once: a dispatch racing the replica death can
     # land on the dead actor, so allow a couple of retries.
@@ -94,7 +98,6 @@ def test_replica_failure_recovery(cluster):
         except ray_tpu.RayTpuError:
             time.sleep(1.0)
     assert result == 7
-    assert serve.status()["Flaky"]["replicas"] == 2
     serve.delete("Flaky")
 
 
@@ -119,31 +122,44 @@ def test_autoscaling_up_and_down(cluster):
 
     handle = serve.run(Slow.bind())
     assert serve.status()["Slow"]["replicas"] == 1
-    # sustained burst: keep requests in flight until the controller reacts
-    # (generous window — CI shares one vCPU across the whole cluster)
+    # sustained load so the autoscaler sees ongoing requests, then a
+    # condition-based wait for the scale-up (controller-side long-poll
+    # instead of client sleep-polling; the load thread keeps requests in
+    # flight the whole time). 120s budget: scale-up = actor creation =
+    # worker cold boot, which takes >60s when the suite saturates the box.
+    import threading
+
     refs = []
-    # scale-up = actor creation = worker cold boot, which takes >60s when
-    # the full suite has the box saturated — this window is generous on
-    # purpose; it only costs time when the test would otherwise fail
-    deadline = time.time() + 120
-    scaled = False
-    while time.time() < deadline:
-        refs.extend(handle.remote(i) for i in range(4))
-        time.sleep(0.4)
-        if serve.status()["Slow"]["replicas"] >= 2:
-            scaled = True
-            break
-    assert scaled, "should scale up under load"
+    stop_load = threading.Event()
+
+    def pump():
+        while not stop_load.is_set():
+            refs.extend(handle.remote(i) for i in range(4))
+            stop_load.wait(0.4)
+
+    loader = threading.Thread(target=pump, daemon=True)
+    loader.start()
+    try:
+        st = ray_tpu.get(
+            handle._controller.wait_status.remote(
+                "Slow", min_replicas=2, timeout_s=120
+            ),
+            timeout=150,
+        )
+    finally:
+        stop_load.set()
+        loader.join(timeout=10)
+    assert st and st["replicas"] >= 2, f"should scale up under load: {st}"
     ray_tpu.get(refs, timeout=120)
-    # idle: scales back toward min
-    deadline = time.time() + 90
-    replicas_now = serve.status()["Slow"]["replicas"]
-    while time.time() < deadline:
-        replicas_now = serve.status()["Slow"]["replicas"]
-        if replicas_now == 1:
-            break
-        time.sleep(0.5)
-    assert replicas_now == 1, "should scale down when idle"
+    # idle: scales back toward min (quiescent: the drain of the surplus
+    # replica must have completed too)
+    st = ray_tpu.get(
+        handle._controller.wait_status.remote(
+            "Slow", max_replicas=1, quiescent=True, timeout_s=90
+        ),
+        timeout=120,
+    )
+    assert st and st["replicas"] == 1, f"should scale down when idle: {st}"
     serve.delete("Slow")
 
 
@@ -257,20 +273,19 @@ def test_rolling_update_zero_downtime(cluster):
             Versioned.options(version="v2").bind("v2"), name="versioned"
         )
         # wait for the ROLL to finish (every routed replica on v2, none
-        # starting/draining) — breaking on the first 'v2' response races
-        # a legitimately-mixed routing set mid-roll (advisor finding r4)
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
-            st = serve.status().get("Versioned", {})
-            if (
-                st.get("version") == "v2"
-                and st.get("replicas_current_version") == st.get("replicas")
-                and st.get("replicas", 0) >= 2
-                and st.get("starting", 0) == 0
-                and st.get("draining", 0) == 0
-            ):
-                break
-            time.sleep(0.2)
+        # starting/draining) via the controller's condition-based
+        # long-poll — breaking on the first 'v2' response races a
+        # legitimately-mixed routing set mid-roll (advisor finding r4)
+        ray_tpu.get(
+            handle._controller.wait_status.remote(
+                "Versioned",
+                min_replicas=2,
+                quiescent=True,
+                version="v2",
+                timeout_s=60,
+            ),
+            timeout=90,
+        )
         # a few post-roll requests must all answer v2
         post_roll = [handle.call(0, _timeout=30) for _ in range(3)]
     finally:

@@ -68,6 +68,7 @@ def _dp_train_fn(config):
         train.report({"loss": loss, "step": step}, checkpoint=out_ckpt)
 
 
+@pytest.mark.slow
 def test_two_worker_dp_loss_goes_down(cluster, tmp_path):
     trainer = JaxTrainer(
         _dp_train_fn,

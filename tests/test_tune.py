@@ -309,6 +309,7 @@ def _surrogate_objective(config):
     tune.report(score=score)
 
 
+@pytest.mark.slow
 def test_tpe_beats_random_on_surrogate(cluster):
     """Seeded head-to-head (the reference's searcher-quality test
     shape): TPE must find a better optimum than random search under the
@@ -341,6 +342,7 @@ def test_tpe_beats_random_on_surrogate(cluster):
     assert tpe > 0.35  # near the optimum (0.5 max)
 
 
+@pytest.mark.slow
 def test_concurrency_limiter_bounds_inflight(cluster):
     from ray_tpu import tune
     from ray_tpu.tune import TuneConfig, Tuner
@@ -417,6 +419,7 @@ def test_median_stopping_rule_stops_bad_trials(cluster):
     }
 
 
+@pytest.mark.slow
 def test_logger_callbacks_write_files(cluster, tmp_path):
     from ray_tpu import train, tune
     from ray_tpu.tune import (
