@@ -96,6 +96,10 @@ class ActorHandle:
         self._max_concurrency = max(1, max_concurrency)
         self._seq_lock = threading.Lock()
         self._seq_no = 0
+        # per-method cached task-spec templates (invariant fields spliced
+        # with per-call args/seq at submit); False = method not
+        # templatable. Rebuilt lazily, never serialized with the handle.
+        self._templates: Dict[str, Any] = {}
 
     def __del__(self):
         if not getattr(self, "_owned", False):
@@ -137,6 +141,42 @@ class ActorHandle:
 
     def _submit_method(self, method_name: str, args, kwargs, opts: Dict[str, Any]):
         worker = _global_worker()
+        # Fast path — cached spec template. Only the DEFAULT method opts
+        # (the dict stored in method_opts, handed out by __getattr__) are
+        # templatable: an .options() override builds a fresh merged dict,
+        # which falls through to the slow path below. Built-in __ray_*
+        # methods stay on the slow path — __ray_terminate__ runs from
+        # __del__ (possibly ON the io loop), where first-call template
+        # registration (a blocking kv_put) could deadlock.
+        if not method_name.startswith("__ray_") and (
+            opts is self._method_opts.get(method_name) or not opts
+        ):
+            tmpl = self._templates.get(method_name)
+            if tmpl is not False:
+                if not worker.template_current(tmpl):
+                    topts0 = TaskOptions().merged_with(
+                        **{
+                            k: v
+                            for k, v in opts.items()
+                            if k in TaskOptions.__dataclass_fields__
+                        }
+                    )
+                    tmpl = worker.make_spec_template(
+                        TaskKind.ACTOR_TASK,
+                        None,
+                        method_name,
+                        topts0,
+                        actor_id=self._actor_id,
+                        method_name=method_name,
+                        default_cpus=0.0,
+                        max_concurrency=self._max_concurrency,
+                        concurrency_group=opts.get("concurrency_group"),
+                    )
+                    self._templates[method_name] = tmpl if tmpl is not None else False
+                if tmpl:
+                    return worker.submit_from_template(
+                        tmpl, args, kwargs, seq_no=self._next_seq()
+                    )
         topts = TaskOptions().merged_with(
             **{k: v for k, v in opts.items() if k in TaskOptions.__dataclass_fields__}
         )

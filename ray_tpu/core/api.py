@@ -27,9 +27,10 @@ from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.exceptions import GetTimeoutError, TaskError
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.refs import Address, ObjectRef, set_refcount_hooks
-from ray_tpu.core.function_manager import FunctionTable
+from ray_tpu.core.function_manager import FunctionTable, TemplateTable
 from ray_tpu.core.task_spec import (
     DefaultScheduling,
+    SpecTemplate,
     TaskKind,
     TaskOptions,
     TaskSpec,
@@ -134,6 +135,7 @@ class Worker:
         self._packaged_envs: Dict[Any, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         self.fn_table = FunctionTable(backend.kv_put, backend.kv_get)
+        self.tmpl_table = TemplateTable(backend.kv_put)
         set_refcount_hooks(self._on_ref_created, self._on_ref_deleted, self._on_ref_borrowed)
 
     # ---- task context --------------------------------------------------
@@ -363,6 +365,87 @@ class Worker:
             lifetime=opts.lifetime,
             method_name=method_name,
         )
+
+    # ---- cached task-spec templates (submit fast path) -----------------
+    def make_spec_template(
+        self,
+        kind: TaskKind,
+        function_obj: Any,
+        name: str,
+        opts: TaskOptions,
+        *,
+        actor_id: Optional[ActorID] = None,
+        method_name: Optional[str] = None,
+        default_cpus: float = 1.0,
+        max_concurrency: int = 1,
+        concurrency_group: Optional[str] = None,
+    ) -> Optional[SpecTemplate]:
+        """Capture the invariant spec fields of one remote function /
+        actor method ONCE (reference: cached serialized task-spec
+        prefix). Returns None for shapes the fast path doesn't cover
+        (streaming/dynamic returns, runtime_env — its packaging is
+        re-signatured per submit)."""
+        num_returns = opts.num_returns if opts.num_returns is not None else 1
+        if not isinstance(num_returns, int) or opts.runtime_env:
+            return None
+        max_retries = (
+            opts.max_retries
+            if opts.max_retries is not None
+            else (GLOBAL_CONFIG.task_max_retries if kind == TaskKind.NORMAL else 0)
+        )
+        return self.tmpl_table.register(
+            dict(
+                kind=kind,
+                name=name,
+                function_id=self.fn_table.export(function_obj),
+                num_returns=num_returns,
+                resources=opts.resource_request(default_cpus).to_dict(),
+                scheduling_strategy=opts.scheduling_strategy,
+                owner=self.address,
+                job_id=self.job_id,
+                max_retries=max_retries,
+                retry_exceptions=opts.retry_exceptions,
+                runtime_env=None,
+                actor_id=actor_id,
+                method_name=method_name,
+                max_concurrency=max_concurrency,
+                concurrency_group=concurrency_group,
+            )
+        )
+
+    def template_current(self, tmpl: Optional[SpecTemplate]) -> bool:
+        """A cached template is reusable only while its captured process
+        identity holds (job and owner address change across init cycles
+        and across tasks on a reused worker)."""
+        return (
+            tmpl is not None
+            and tmpl.job_id == self.job_id
+            and tmpl.owner is self.address
+        )
+
+    def submit_from_template(self, tmpl: SpecTemplate, args, kwargs, seq_no: int = 0):
+        """Hot-path submit: splice per-call fields into a cached template
+        — no TaskOptions merging, resource normalization, or descriptor
+        re-export per call."""
+        from ray_tpu.core.deadline import remaining as _deadline_remaining
+
+        task_id = self.new_task_id()
+        sargs, skwargs = self._serialize_args(args, kwargs)
+        return_ids = [
+            ObjectID.from_index(task_id, i + 1) for i in range(tmpl.num_returns)
+        ]
+        spec = tmpl.instantiate(
+            task_id, sargs, skwargs, return_ids, _deadline_remaining(), seq_no
+        )
+        if tmpl.kind == TaskKind.ACTOR_TASK:
+            self.backend.submit_actor_task(spec)
+        else:
+            self.backend.submit_task(spec)
+        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids]
+        self.backend.release_hold(spec.return_ids)
+        if tmpl.num_returns == 0:
+            return None
+        return refs[0] if tmpl.num_returns == 1 else refs
 
     def submit_task(self, function_obj, name, args, kwargs, opts: TaskOptions):
         spec = self.make_task_spec(TaskKind.NORMAL, function_obj, name, args, kwargs, opts)

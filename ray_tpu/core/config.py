@@ -26,6 +26,13 @@ class GlobalConfig:
     # in-process memory store and shipped inside RPC replies instead of
     # going through shared memory (reference: task output inlining).
     max_direct_call_object_size: int = 100 * 1024
+    # Task RESULTS at or below this size ride back to the owner inside
+    # the task-done reply and are served from the owner's in-process
+    # inline cache — get() on a small result never touches the shm store
+    # or makes an extra RPC (reference: direct-call inline return limit).
+    # Distinct from max_direct_call_object_size (puts / arg inlining) so
+    # the two paths can be tuned independently.
+    inline_result_threshold_bytes: int = 100 * 1024
     # Chunk size for node-to-node object transfer (reference 5 MiB,
     # ``ray_config_def.h:341``).
     object_transfer_chunk_bytes: int = 5 * 1024**2
@@ -158,6 +165,14 @@ class GlobalConfig:
     drain_flush_objects: bool = True
 
     # --- RPC ---
+    #: frames per coalesced batch frame on a connection flush (RPC
+    #: micro-batching): a flush packs up to this many queued frames into
+    #: one wire frame, so the receiver dispatches them from a single
+    #: read wakeup. 1 disables batching (every frame travels alone).
+    rpc_batch_max_frames: int = 64
+    #: byte ceiling for one batch frame — oversized frames travel alone
+    #: so a huge payload can't add head-of-line latency to tiny ones
+    rpc_batch_max_bytes: int = 256 * 1024
     rpc_connect_timeout_s: float = 10.0
     rpc_retry_base_delay_s: float = 0.05
     rpc_retry_max_delay_s: float = 2.0

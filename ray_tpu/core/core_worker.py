@@ -57,9 +57,28 @@ from ray_tpu.core.rpc import (
     RpcClient,
     RpcServer,
 )
-from ray_tpu.core.task_spec import TaskKind, TaskSpec
+from ray_tpu.core.task_spec import TaskKind, TaskSpec, encode_spec
 
 logger = logging.getLogger(__name__)
+
+
+def _loop_event_setter(loop, ev: "asyncio.Event"):
+    """Completion callback that sets an asyncio.Event from ANY thread:
+    plain set() when already on the target loop (the common case — reply
+    processing runs there, and call_soon_threadsafe's self-pipe write is
+    a ~1ms syscall under load), threadsafe wakeup otherwise."""
+
+    def cb():
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            ev.set()
+        else:
+            loop.call_soon_threadsafe(ev.set)
+
+    return cb
 
 
 class _ClassQueue:
@@ -142,6 +161,10 @@ class CoreWorker(RuntimeBackend):
         # wait(timeout=0) poll answer from cache instead of paying the
         # borrowed-status grace window every call (bounded FIFO)
         self._borrowed_ready: "OrderedDict[bytes, None]" = OrderedDict()
+        # executor-side cache of task-spec templates (template_id →
+        # SpecTemplate): pushes carry (template_id, per-call fields);
+        # the full invariant prefix is fetched from the KV once
+        self._tmpl_cache: Dict[bytes, Any] = {}
         # task-event buffer (``core_worker/task_event_buffer`` →
         # ``GcsTaskManager``): batched lifecycle events for `list tasks`.
         # Locked: emitters run on lane/user threads, the flusher swaps the
@@ -230,11 +253,45 @@ class CoreWorker(RuntimeBackend):
     # objects: get
     def get_objects(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
+        # Sync fast path for owned refs: resolve on the CALLING thread —
+        # in-process cache hits return immediately, pending results park
+        # on the ownership table's threading waiters. The io loop stays
+        # free to process completions (it paid ~70µs of task/event/timer
+        # machinery per ref in the async path, plus two cross-thread
+        # wakeups per get() call). Borrowed refs and shm-resident values
+        # drop to the async path (owner RPCs / store fetches live there).
+        out: List[Any] = []
+        for i, r in enumerate(refs):
+            oid = r.id()
+            data = self.memory.get(oid)
+            if data is not None:
+                out.append(serialization.deserialize_bytes(data))
+                continue
+            if not self.refcounter.owns(oid):
+                break  # borrowed: async path handles the owner protocol
+            remaining = (
+                None if deadline is None else max(0.0, deadline - time.monotonic())
+            )
+            obj = self.refcounter.wait_ready(oid, remaining)
+            if obj is None or not obj.ready():
+                raise GetTimeoutError(f"get() timed out waiting for {oid.hex()[:12]}")
+            if obj.state == ObjState.FAILED:
+                out.append(obj.error)
+            elif obj.inline is not None:
+                out.append(serialization.deserialize_bytes(obj.inline))
+            else:
+                # shm-resident result: hand this ref AND the rest to the
+                # async path so node-to-node fetches (and any lineage
+                # recovery) overlap instead of running serially here
+                break
+        else:
+            return out
+        rest = list(refs[i:])
 
         async def _get_all():
-            return await asyncio.gather(*[self._get_one(r, deadline) for r in refs])
+            return await asyncio.gather(*[self._get_one(r, deadline) for r in rest])
 
-        return self.io.run(_get_all())
+        return out + self.io.run(_get_all())
 
     async def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
         oid = ref.id()
@@ -254,7 +311,7 @@ class CoreWorker(RuntimeBackend):
             return obj
         loop = asyncio.get_event_loop()
         ev = asyncio.Event()
-        cb = lambda: loop.call_soon_threadsafe(ev.set)  # noqa: E731
+        cb = _loop_event_setter(loop, ev)
         if not self.refcounter.on_ready(oid, cb):
             try:
                 timeout = (
@@ -475,7 +532,7 @@ class CoreWorker(RuntimeBackend):
         if self.refcounter.owns(oid):
             loop = asyncio.get_event_loop()
             ev = asyncio.Event()
-            cb = lambda: loop.call_soon_threadsafe(ev.set)  # noqa: E731
+            cb = _loop_event_setter(loop, ev)
             if self.refcounter.on_ready(oid, cb):
                 return
             try:
@@ -620,10 +677,14 @@ class CoreWorker(RuntimeBackend):
             self.io.loop.call_soon_threadsafe(self._drain_submits)
 
     def _drain_submits(self) -> None:
-        """Runs on the io loop: dispatch every buffered spec."""
+        """Runs on the io loop: dispatch every buffered spec. While a
+        producer thread is mid-burst, the drain RE-ARMS itself with a
+        plain call_soon and keeps ``_submit_scheduled`` set — submits
+        landing during the burst skip the cross-thread self-pipe wakeup
+        (a ~1ms syscall under load on virtualized kernels), paying it
+        once per burst instead of once per task."""
         with self._submit_lock:
             batch, self._submit_buf = self._submit_buf, []
-            self._submit_scheduled = False
         for is_actor, spec in batch:
             try:
                 if is_actor:
@@ -635,6 +696,11 @@ class CoreWorker(RuntimeBackend):
                 self._fail_returns(
                     spec, e if isinstance(e, RayTpuError) else RayTpuError(repr(e))
                 )
+        with self._submit_lock:
+            if self._submit_buf:
+                self.io.loop.call_soon(self._drain_submits)
+            else:
+                self._submit_scheduled = False
 
     def _try_recover(self, oid: ObjectID, observed_locations=None) -> bool:
         """Lineage reconstruction (``object_recovery_manager.h:90``): if
@@ -824,7 +890,7 @@ class CoreWorker(RuntimeBackend):
             try:
                 reply = await worker_client.call(
                     "push_batch",
-                    {"specs": batch},
+                    {"specs": [encode_spec(s) for s in batch]},
                     timeout=None,
                     connect_timeout=3.0,
                 )
@@ -1384,7 +1450,10 @@ class CoreWorker(RuntimeBackend):
                         )
                 try:
                     reply = await client.call(
-                        "push_batch", {"specs": batch}, timeout=None, connect_timeout=3.0
+                        "push_batch",
+                        {"specs": [encode_spec(s) for s in batch]},
+                        timeout=None,
+                        connect_timeout=3.0,
                     )
                 except ChaosInjectedError:
                     # pre-execution injection: retry the batch, actor is
@@ -1471,7 +1540,12 @@ class CoreWorker(RuntimeBackend):
                         st.address.port,
                     )
                 try:
-                    reply = await client.call("push_task", {"spec": spec}, timeout=None, connect_timeout=3.0)
+                    reply = await client.call(
+                        "push_task",
+                        {"spec": encode_spec(spec)},
+                        timeout=None,
+                        connect_timeout=3.0,
+                    )
                 except ChaosInjectedError:
                     await asyncio.sleep(0.02)
                     continue
@@ -1738,7 +1812,7 @@ class CoreWorker(RuntimeBackend):
         if timeout != 0 and (obj is None or not obj.ready()):
             loop = asyncio.get_event_loop()
             ev = asyncio.Event()
-            cb = lambda: loop.call_soon_threadsafe(ev.set)  # noqa: E731
+            cb = _loop_event_setter(loop, ev)
             if not self.refcounter.on_ready(oid, cb):
                 try:
                     await asyncio.wait_for(ev.wait(), timeout)
@@ -1824,6 +1898,32 @@ class CoreWorker(RuntimeBackend):
         return True
 
     # execution services are registered when an executor is attached
+    async def _decode_spec(self, entry) -> TaskSpec:
+        """Rebuild a full TaskSpec from its wire form: template-spliced
+        entries are ``("t", template_id, per-call)``; the invariant
+        prefix is fetched from the control-plane KV once per template."""
+        if isinstance(entry, TaskSpec):
+            return entry
+        _tag, tid, pc = entry
+        tmpl = self._tmpl_cache.get(tid)
+        if tmpl is None:
+            from ray_tpu.core.function_manager import (
+                TEMPLATE_KV_PREFIX,
+                template_from_payload,
+            )
+
+            payload = await self.controller.call(
+                "kv_get",
+                {"key": TEMPLATE_KV_PREFIX + tid},
+                timeout=30,
+                retries=GLOBAL_CONFIG.rpc_max_retries,
+            )
+            if payload is None:
+                raise RayTpuError(f"unknown task template {tid.hex()}")
+            tmpl = template_from_payload(tid, payload)
+            self._tmpl_cache[tid] = tmpl
+        return tmpl.from_percall(pc)
+
     async def w_push_batch(self, payload, conn):
         """Batched task push on a held lease: specs execute serially,
         one framed reply (lease-pipelining companion). Per-spec isolation:
@@ -1832,8 +1932,44 @@ class CoreWorker(RuntimeBackend):
         whole RPC."""
         if self.executor is None:
             raise RuntimeError("this process does not execute tasks")
+        # Per-spec decode isolation: an undecodable entry (template
+        # missing from the KV) becomes ITS error reply — return ids are
+        # recoverable from the per-call tuple without the template.
+        specs: List[Any] = []
+        decode_errors: Dict[int, Dict[str, Any]] = {}
+        for idx, entry in enumerate(payload["specs"]):
+            try:
+                specs.append(await self._decode_spec(entry))
+            except Exception as e:  # noqa: BLE001 — isolate batchmates
+                logger.exception("spec decode failed in batch")
+                err = TaskError("decode", e)
+                ret_ids = entry[2][3] if not isinstance(entry, TaskSpec) else [
+                    oid.binary() for oid in entry.return_ids
+                ]
+                decode_errors[idx] = {
+                    "results": [(rid, "error", pickle.dumps(err)) for rid in ret_ids]
+                }
+                specs.append(None)
+        live = [s for s in specs if s is not None]
+        if decode_errors and (
+            not live or any(s.kind == TaskKind.ACTOR_TASK for s in live)
+        ):
+            # Per-spec isolation is only safe for all-NORMAL batches: an
+            # ordered actor's failed spec would leave a sequence-number
+            # hole (its seq never advances) and wedge every batchmate in
+            # _wait_turn. Fail the whole RPC instead — the owner's batch
+            # error path fails all returns, no hang. (An all-failed
+            # batch can't prove it wasn't an actor batch: same verdict.)
+            raise RayTpuError("task template decode failed in actor batch")
+        if not decode_errors:
+            fast = self.executor.handle_push_batch_fast(live, conn=conn)
+            if fast is not None:
+                return {"replies": await fast}
         replies = []
-        for spec in payload["specs"]:
+        for idx, spec in enumerate(specs):
+            if spec is None:
+                replies.append(decode_errors[idx])
+                continue
             try:
                 replies.append(await self.executor.handle_push_task(spec, conn=conn))
             except Exception as e:  # noqa: BLE001
@@ -1852,7 +1988,8 @@ class CoreWorker(RuntimeBackend):
     async def w_push_task(self, payload, conn):
         if self.executor is None:
             raise RuntimeError("this process does not execute tasks")
-        return await self.executor.handle_push_task(payload["spec"], conn=conn)
+        spec = await self._decode_spec(payload["spec"])
+        return await self.executor.handle_push_task(spec, conn=conn)
 
     async def w_run_actor_creation(self, payload, conn):
         if self.executor is None:

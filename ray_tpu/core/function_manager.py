@@ -17,6 +17,50 @@ from typing import Any, Callable, Dict, Optional
 import cloudpickle
 
 FUNCTION_KV_PREFIX = b"fn:"
+TEMPLATE_KV_PREFIX = b"tmpl:"
+
+
+class TemplateTable:
+    """Client-side registry of cached task-spec templates (the invariant
+    spec prefix, see ``task_spec.SpecTemplate``). Same shape as the
+    function table: pickle once, store in the control-plane KV under a
+    content hash, ship only the 16-byte id per call; executors fetch and
+    cache on first use."""
+
+    def __init__(self, kv_put: Callable[[bytes, bytes], None]):
+        self._kv_put = kv_put
+        self._registered: set = set()
+        self._lock = threading.Lock()
+
+    def register(self, fields: Dict[str, Any]) -> "Any":
+        """``fields``: SpecTemplate constructor kwargs sans template_id.
+        Returns the SpecTemplate (registered in the KV exactly once)."""
+        import pickle
+
+        from ray_tpu.core.task_spec import SpecTemplate
+
+        payload = pickle.dumps(fields, protocol=5)
+        template_id = hashlib.sha256(payload).digest()[:16]
+        with self._lock:
+            known = template_id in self._registered
+        if not known:
+            # mark registered only AFTER the put lands: a concurrent
+            # registrant of the same hash must not skip the put and
+            # submit against a template the KV doesn't hold yet (the
+            # duplicate put is idempotent — same key, same bytes)
+            self._kv_put(TEMPLATE_KV_PREFIX + template_id, payload)
+            with self._lock:
+                self._registered.add(template_id)
+        return SpecTemplate(template_id=template_id, **fields)
+
+
+def template_from_payload(template_id: bytes, payload: bytes):
+    """Executor-side: rebuild a SpecTemplate from its KV payload."""
+    import pickle
+
+    from ray_tpu.core.task_spec import SpecTemplate
+
+    return SpecTemplate(template_id=template_id, **pickle.loads(payload))
 
 
 class FunctionTable:

@@ -682,7 +682,10 @@ class NodeDaemon:
             # one worker to two leases (deadlock on its execution lane).
             w.idle_since = time.monotonic()
             self.idle.append(w)
-            self._notify_capacity()
+        # always notify: a _pop_worker parked on ITS claimed spawn wakes
+        # on this registration instead of its poll timeout (the lease
+        # grant sits on the submit hot path during pump growth)
+        self._notify_capacity()
         return {"node_id": self.node_id.binary()}
 
     async def _run_actor_creation(self, w: WorkerProc, spec: TaskSpec) -> None:
@@ -989,7 +992,13 @@ class NodeDaemon:
             if w.proc.poll() is not None:
                 w.claimed = False
                 return None
-            await asyncio.sleep(0.01)
+            # event-driven: d_register_worker notifies capacity, so the
+            # grant fires the moment the worker registers — the timeout
+            # only paces the liveness re-check of the spawned process
+            try:
+                await asyncio.wait_for(self._capacity_event.wait(), timeout=0.05)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
         # spawn timed out: release the claim; if it registered late, give
         # it to the idle pool so it isn't orphaned
         w.claimed = False

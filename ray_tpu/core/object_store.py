@@ -628,13 +628,16 @@ class MemoryStore:
         if ev:
             ev.set()
 
+    # Reads are lock-free: dict.get on a key is atomic under the GIL and
+    # this store is the owner-side INLINE CACHE — every get() on a small
+    # task result goes through here, so a lock acquire per read is pure
+    # hot-path overhead. Mutation (put/delete) stays locked for the
+    # event bookkeeping.
     def get(self, object_id: ObjectID) -> Optional[bytes]:
-        with self._lock:
-            return self._data.get(object_id)
+        return self._data.get(object_id)
 
     def contains(self, object_id: ObjectID) -> bool:
-        with self._lock:
-            return object_id in self._data
+        return object_id in self._data
 
     def wait_for(self, object_id: ObjectID, timeout: Optional[float]) -> Optional[bytes]:
         with self._lock:

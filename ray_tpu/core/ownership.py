@@ -174,6 +174,16 @@ class ReferenceCounter:
             ev = threading.Event()
             obj.waiters.append(ev)
         if not ev.wait(timeout):
+            # timed-out waiter must deregister: polling get(timeout=...)
+            # loops on a slow object would otherwise grow waiters without
+            # bound (completion is the only other drain)
+            with self._lock:
+                obj = self._objects.get(object_id)
+                if obj is not None:
+                    try:
+                        obj.waiters.remove(ev)
+                    except ValueError:
+                        pass
             return None
         with self._lock:
             return self._objects.get(object_id)
@@ -243,6 +253,11 @@ class ReferenceCounter:
             if obj.recovering or obj.state == ObjState.PENDING:
                 return ("pending", None, {})
             if obj.state != ObjState.AVAILABLE:
+                return ("no", None, {})
+            if obj.inline is not None:
+                # inline values live in the owner's memory and cannot be
+                # lost to node death/drain — never burn a reconstruction
+                # attempt on one (treat as always-available)
                 return ("no", None, {})
             if observed_locations is not None and (
                 obj.locations - {tuple(l) for l in observed_locations}

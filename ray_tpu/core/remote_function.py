@@ -6,7 +6,7 @@ import functools
 from typing import Any, Callable, Optional
 
 from ray_tpu.core.api import _global_worker
-from ray_tpu.core.task_spec import TaskOptions
+from ray_tpu.core.task_spec import TaskKind, TaskOptions
 
 
 class RemoteFunction:
@@ -16,6 +16,10 @@ class RemoteFunction:
         self._function = function
         self._opts = opts or TaskOptions()
         self._name = getattr(function, "__qualname__", getattr(function, "__name__", "fn"))
+        # cached task-spec template (invariant spec fields serialized
+        # once; per-call fields spliced at submit). False = shape not
+        # templatable (streaming / runtime_env) — don't retry per call.
+        self._template = None
         functools.update_wrapper(self, function, updated=[])
 
     def __call__(self, *args, **kwargs):
@@ -25,7 +29,20 @@ class RemoteFunction:
         )
 
     def remote(self, *args, **kwargs):
-        return _global_worker().submit_task(self._function, self._name, args, kwargs, self._opts)
+        worker = _global_worker()
+        tmpl = self._template
+        if tmpl is False:
+            return worker.submit_task(self._function, self._name, args, kwargs, self._opts)
+        if not worker.template_current(tmpl):
+            tmpl = worker.make_spec_template(
+                TaskKind.NORMAL, self._function, self._name, self._opts
+            )
+            self._template = tmpl if tmpl is not None else False
+            if tmpl is None:
+                return worker.submit_task(
+                    self._function, self._name, args, kwargs, self._opts
+                )
+        return worker.submit_from_template(tmpl, args, kwargs)
 
     def options(self, **updates) -> "RemoteFunction":
         return RemoteFunction(self._function, self._opts.merged_with(**updates))

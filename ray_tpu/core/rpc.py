@@ -12,7 +12,11 @@ Frame format (all little-endian):
     [u32 length] [msgpack: [kind, seq, method, payload_bytes]]
 
 kinds: 0=request, 1=reply-ok, 2=reply-err, 3=push (server-initiated,
-seq identifies the subscription).
+seq identifies the subscription), 4=batch (micro-batching: the payload
+slot carries a FIFO list of packed sub-frame bodies — a flush coalesces
+every frame queued on a connection into batch frames, and the receiver
+dispatches all of them from ONE read wakeup instead of a wakeup per
+frame; per-connection FIFO order is preserved).
 Payloads are pickled (cloudpickle-compatible dataclasses travel as-is);
 the store's bulk data paths use raw bytes to avoid copies.
 """
@@ -35,7 +39,7 @@ from ray_tpu.core.config import GLOBAL_CONFIG
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<I")
-REQUEST, REPLY_OK, REPLY_ERR, PUSH = 0, 1, 2, 3
+REQUEST, REPLY_OK, REPLY_ERR, PUSH, BATCH = 0, 1, 2, 3, 4
 
 MAX_FRAME = 1 << 31
 
@@ -87,9 +91,62 @@ async def _read_frame(reader: asyncio.StreamReader):
     return msgpack.unpackb(data, raw=True, use_list=True)
 
 
+def _iter_messages(msg):
+    """Expand one wire frame into its logical messages: a BATCH frame's
+    payload slot is the FIFO list of packed sub-frame bodies; anything
+    else is itself. Batches never nest."""
+    if msg[0] != BATCH:
+        yield msg
+        return
+    for body in msg[3]:
+        yield msgpack.unpackb(body, raw=True, use_list=True)
+
+
+def _encode_body(kind: int, seq: int, method: bytes, payload: bytes) -> bytes:
+    """A frame body WITHOUT the length prefix (the unit of batching)."""
+    return msgpack.packb([kind, seq, method, payload], use_bin_type=True)
+
+
 def _encode_frame(kind: int, seq: int, method: bytes, payload: bytes) -> bytes:
-    body = msgpack.packb([kind, seq, method, payload], use_bin_type=True)
+    body = _encode_body(kind, seq, method, payload)
     return _LEN.pack(len(body)) + body
+
+
+def _wire_from_bodies(bodies: list) -> bytes:
+    """Serialize a FIFO list of frame bodies for one send: consecutive
+    bodies coalesce into BATCH frames up to ``rpc_batch_max_frames`` /
+    ``rpc_batch_max_bytes``; singletons travel as plain frames. Order on
+    the wire is exactly the queue order, so per-connection FIFO holds."""
+    max_frames = GLOBAL_CONFIG.rpc_batch_max_frames
+    max_bytes = GLOBAL_CONFIG.rpc_batch_max_bytes
+    if len(bodies) == 1 or max_frames <= 1:
+        return b"".join(_LEN.pack(len(b)) + b for b in bodies)
+    out: list = []
+    group: list = []
+    group_bytes = 0
+
+    def close():
+        nonlocal group, group_bytes
+        if not group:
+            return
+        if len(group) == 1:
+            body = group[0]
+        else:
+            body = msgpack.packb([BATCH, 0, b"", group], use_bin_type=True)
+        out.append(_LEN.pack(len(body)))
+        out.append(body)
+        group = []
+        group_bytes = 0
+
+    for body in bodies:
+        if group and (
+            len(group) >= max_frames or group_bytes + len(body) > max_bytes
+        ):
+            close()
+        group.append(body)
+        group_bytes += len(body)
+    close()
+    return b"".join(out)
 
 
 class RpcServer:
@@ -126,14 +183,18 @@ class RpcServer:
         try:
             while True:
                 try:
-                    kind, seq, method, payload = await _read_frame(reader)
+                    msg = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
                     break
-                if kind != REQUEST:
-                    continue
-                asyncio.ensure_future(
-                    self._dispatch(conn, seq, method, payload, time.monotonic())
-                )
+                # a BATCH frame dispatches all its requests from this ONE
+                # read wakeup, in queue order (micro-batching)
+                enqueued_at = time.monotonic()
+                for kind, seq, method, payload in _iter_messages(msg):
+                    if kind != REQUEST:
+                        continue
+                    asyncio.ensure_future(
+                        self._dispatch(conn, seq, method, payload, enqueued_at)
+                    )
         finally:
             self._conns.discard(conn)
             conn._closed = True
@@ -210,9 +271,9 @@ class ServerConnection:
     async def send(self, kind: int, seq: int, method: bytes, payload: bytes) -> None:
         if self._closed:
             raise ConnectionLost("connection closed")
-        frame = _encode_frame(kind, seq, method, payload)
-        self._out.append(frame)
-        self._out_bytes = getattr(self, "_out_bytes", 0) + len(frame)
+        body = _encode_body(kind, seq, method, payload)
+        self._out.append(body)
+        self._out_bytes = getattr(self, "_out_bytes", 0) + len(body)
         if self._out_bytes >= _FLUSH_BYTES:
             # large buffers flush NOW: the cork trades one loop tick of
             # latency for syscall coalescing, but drain()'s flow control
@@ -228,10 +289,12 @@ class ServerConnection:
         if not self._out or self._closed:
             self._out.clear()
             return
-        frames, self._out = self._out, []
+        bodies, self._out = self._out, []
         self._out_bytes = 0
         try:
-            self.writer.write(b"".join(frames) if len(frames) > 1 else frames[0])
+            # queued frames coalesce into batch frames: the peer gets one
+            # read wakeup for the whole flush (micro-batching)
+            self.writer.write(_wire_from_bodies(bodies))
         except Exception:
             # mark closed so subsequent sends fail fast instead of
             # buffering into a dead socket until the reader notices
@@ -299,22 +362,23 @@ class RpcClient:
     async def _read_loop(self, reader, writer, pending):
         try:
             while True:
-                kind, seq, method, payload = await _read_frame(reader)
-                if kind == PUSH:
-                    handler = self._push_handlers.get(seq)
-                    if handler is not None:
-                        try:
-                            handler(pickle.loads(payload))
-                        except Exception:
-                            logger.exception("push handler failed")
-                    continue
-                fut = pending.pop(seq, None)
-                if fut is None or fut.done():
-                    continue
-                if kind == REPLY_OK:
-                    fut.set_result(pickle.loads(payload))
-                else:
-                    fut.set_exception(pickle.loads(payload))
+                msg = await _read_frame(reader)
+                for kind, seq, method, payload in _iter_messages(msg):
+                    if kind == PUSH:
+                        handler = self._push_handlers.get(seq)
+                        if handler is not None:
+                            try:
+                                handler(pickle.loads(payload))
+                            except Exception:
+                                logger.exception("push handler failed")
+                        continue
+                    fut = pending.pop(seq, None)
+                    if fut is None or fut.done():
+                        continue
+                    if kind == REPLY_OK:
+                        fut.set_result(pickle.loads(payload))
+                    else:
+                        fut.set_exception(pickle.loads(payload))
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
             pass
         finally:
@@ -360,11 +424,11 @@ class RpcClient:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[seq] = fut
         try:
-            frame = _encode_frame(
+            body = _encode_body(
                 REQUEST, seq, method.encode(), pickle.dumps(payload, protocol=5)
             )
-            self._out.append(frame)
-            self._out_bytes = getattr(self, "_out_bytes", 0) + len(frame)
+            self._out.append(body)
+            self._out_bytes = getattr(self, "_out_bytes", 0) + len(body)
             if self._out_bytes >= _FLUSH_BYTES:
                 self._flush()  # see ServerConnection.send: bound the cork
             elif not self._flush_scheduled:
@@ -385,10 +449,11 @@ class RpcClient:
             self._out.clear()
             self._out_bytes = 0
             return
-        frames, self._out = self._out, []
+        bodies, self._out = self._out, []
         self._out_bytes = 0
         try:
-            writer.write(b"".join(frames) if len(frames) > 1 else frames[0])
+            # one write, frames coalesced into batch frames (micro-batching)
+            writer.write(_wire_from_bodies(bodies))
         except Exception:
             # fail in-flight calls NOW — waiting for the read loop to
             # notice the dead socket can add a full timeout of latency

@@ -202,7 +202,16 @@ def ensure_importable_or_by_value(obj: Any) -> None:
         pass
 
 
+# Exact-type primitive fast path for serialize(): these values cannot
+# contain ObjectRefs and never need cloudpickle, so the hot result/arg
+# path (noop returns, small scalars) skips CloudPickler construction.
+_PRIMITIVE_TYPES = frozenset({type(None), bool, int, float, bytes, str})
+
+
 def serialize(value: Any) -> SerializedValue:
+    if type(value) in _PRIMITIVE_TYPES and not _custom_serializers:
+        return SerializedValue(pickle.dumps(value, protocol=5), [], [])
+
     from ray_tpu.core.refs import ObjectRef  # cycle: refs uses serialization
 
     buffers: List = []

@@ -47,6 +47,10 @@ class TaskExecutor:
         # execution start + thread idents of tasks currently executing
         self._cancelled: set = set()
         self._running_threads: Dict[bytes, int] = {}
+        # threads currently running a BATCH (handle_push_batch_fast):
+        # async-exc delivery is unsafe there — a late exception would
+        # land in a batchmate, not an idle retired thread
+        self._batch_idents: set = set()
         self._cancel_lock = threading.Lock()
         self._env_gen = 0  # runtime-env application generation
         # streaming backpressure: owner-reported consumer positions
@@ -139,6 +143,89 @@ class TaskExecutor:
             ).result(timeout=60)
 
         return emit
+
+    def handle_push_batch_fast(self, specs: List[TaskSpec], conn=None):
+        """Single-dispatch batch execution, or None when the batch's
+        shape needs the general per-spec path. A lane handoff costs two
+        condvar hops (slow syscalls under load) — for a micro-task batch
+        those dominate, so the whole batch rides ONE run_in_executor.
+
+        Covered shapes: all plain non-streaming normal tasks, or all
+        non-streaming sync methods of THIS ordered (max_concurrency==1,
+        no concurrency groups) actor from one caller — the batch is
+        executed serially in order, exactly like the per-spec path."""
+        if len(specs) < 2:
+            return None
+        loop = asyncio.get_event_loop()
+        if all(
+            s.kind == TaskKind.NORMAL and s.num_returns != "streaming"
+            for s in specs
+        ):
+            return loop.run_in_executor(
+                self._default_lane, self._execute_batch, specs
+            )
+        if (
+            self._max_concurrency == 1
+            and not self._lanes
+            and all(
+                s.kind == TaskKind.ACTOR_TASK
+                and s.num_returns != "streaming"
+                and not s.concurrency_group
+                and s.method_name
+                and not s.method_name.startswith("__ray_")
+                and not inspect.iscoroutinefunction(
+                    getattr(self._actor_instance, s.method_name, None)
+                )
+                and s.owner is not None
+                and s.owner.worker_id == specs[0].owner.worker_id
+                for s in specs
+            )
+        ):
+            return self._ordered_actor_batch(specs)
+        return None
+
+    async def _ordered_actor_batch(self, specs: List[TaskSpec]) -> List[Dict[str, Any]]:
+        caller = specs[0].owner.worker_id
+        await self._wait_turn(caller, specs[0].seq_no)
+        loop = asyncio.get_event_loop()
+        fut = loop.run_in_executor(self._default_lane, self._execute_batch, specs)
+        # the whole batch occupies the single-thread lane serially, so
+        # advancing all seqs now preserves the per-caller order contract
+        # (exactly like per-spec dispatch into the same lane)
+        for _ in specs:
+            self._advance(caller)
+        return await fut
+
+    def _execute_batch(self, specs: List[TaskSpec]) -> List[Dict[str, Any]]:
+        """Lane thread: run a batch serially with per-spec isolation.
+
+        The thread registers itself as batch-running so cancel_task
+        switches to cooperative-only delivery (the async-exc mechanism
+        assumes a retired lane's thread goes idle after its one task —
+        here it would poison a batchmate instead)."""
+        ident = threading.get_ident()
+        with self._cancel_lock:
+            self._batch_idents.add(ident)
+        try:
+            replies = []
+            for spec in specs:
+                try:
+                    replies.append({"results": self._execute(spec)})
+                except Exception as e:  # noqa: BLE001 — isolate batchmates
+                    logger.exception("task %s failed in batch", spec.name)
+                    err = TaskError(spec.name, e)
+                    replies.append(
+                        {
+                            "results": [
+                                (oid.binary(), "error", pickle.dumps(err))
+                                for oid in spec.return_ids
+                            ]
+                        }
+                    )
+            return replies
+        finally:
+            with self._cancel_lock:
+                self._batch_idents.discard(ident)
 
     async def handle_push_task(self, spec: TaskSpec, conn=None) -> Dict[str, Any]:
         if spec.kind == TaskKind.ACTOR_TASK:
@@ -264,10 +351,17 @@ class TaskExecutor:
             install_loop_monitor(self._async_loop, "actor-async")
 
         loop0 = asyncio.get_event_loop()
-        # arg resolution can block on remote objects — keep it off the io loop
-        args, kwargs = await loop0.run_in_executor(
-            None, execution.resolve_args, spec, self._get_dep
-        )
+        # Arg resolution can block on remote objects — keep it off the io
+        # loop. Pure-inline args (the common small-call shape) can't
+        # block: resolve them right here and skip the thread hop.
+        if any(t == "ref" for t, _ in spec.args) or any(
+            t == "ref" for t, _k, _v in spec.kwargs
+        ):
+            args, kwargs = await loop0.run_in_executor(
+                None, execution.resolve_args, spec, self._get_dep
+            )
+        else:
+            args, kwargs = execution.resolve_args(spec, self._get_dep)
 
         async def _run():
             # Per-coroutine task context (ContextVar) so puts made inside the
@@ -288,7 +382,10 @@ class TaskExecutor:
         cfut = asyncio.run_coroutine_threadsafe(_run(), self._async_loop)
         loop = asyncio.get_event_loop()
         try:
-            result = await loop.run_in_executor(None, cfut.result)
+            # await the cross-loop future directly — the old
+            # run_in_executor(None, cfut.result) parked an executor
+            # thread per in-flight call (two futex hops each)
+            result = await asyncio.wrap_future(cfut)
             pairs = execution.unpack_returns(spec, result)
         except Exception as e:  # noqa: BLE001
             err = TaskError(spec.name, e)
@@ -378,6 +475,14 @@ class TaskExecutor:
         with self._cancel_lock:
             self._cancelled.add(task_id)
             ident = self._running_threads.get(task_id)
+            if ident is not None and ident in self._batch_idents:
+                # Batch-running thread: async-exc could land in a
+                # batchmate (the thread is NOT idle after the target
+                # finishes). Cooperative-only — queued batchmates see
+                # the cancel mark at their execution boundary; the
+                # running task completes (force=True still kills the
+                # process).
+                ident = None
         if ident is not None:
             import ctypes
 
@@ -551,7 +656,10 @@ class TaskExecutor:
             ser = serialization.serialize(value)
         except Exception as e:  # noqa: BLE001
             return ("error", pickle.dumps(TaskError(name or "serialize", e)))
-        if ser.total_bytes <= GLOBAL_CONFIG.max_direct_call_object_size:
+        # results use their own inline ceiling (inline returns ride the
+        # task-done reply to the owner's in-process cache; puts/args keep
+        # max_direct_call_object_size)
+        if ser.total_bytes <= GLOBAL_CONFIG.inline_result_threshold_bytes:
             return ("inline", ser.to_bytes())
         size = self.core.shm.create_and_write(oid, ser)
         self.core.io.run(

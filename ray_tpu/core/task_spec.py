@@ -149,8 +149,107 @@ class TaskSpec:
     method_name: Optional[str] = None
     seq_no: int = 0
     concurrency_group: Optional[str] = None
+    # Set when this spec was spliced from a cached SpecTemplate: the
+    # submit path ships (template_id, per-call fields) instead of the
+    # full spec — executors rebuild it from their template cache.
+    template_id: Optional[bytes] = None
 
     def dependencies(self) -> List[ObjectRef]:
         deps = [a for t, a in self.args if t == "ref"]
         deps += [v for t, _k, v in self.kwargs if t == "ref"]
         return deps
+
+
+@dataclass
+class SpecTemplate:
+    """Invariant fields of every call to one remote function / actor
+    method, captured ONCE at decoration (first-call) time — the
+    reference's cached serialized task-spec prefix. The serialized form
+    is registered in the control-plane KV under ``template_id``; submits
+    splice only per-call fields (task id, args, return ids, deadline,
+    seq_no), so the hot path never re-pickles the function descriptor,
+    resources, scheduling class, or owner address."""
+
+    template_id: bytes
+    kind: TaskKind
+    name: str
+    function_id: bytes
+    num_returns: int
+    resources: Dict[str, float]
+    scheduling_strategy: SchedulingStrategy
+    owner: Optional[Address]
+    job_id: JobID
+    max_retries: int = 0
+    retry_exceptions: Any = False
+    runtime_env: Optional[Dict[str, Any]] = None
+    actor_id: Optional[ActorID] = None
+    method_name: Optional[str] = None
+    max_concurrency: int = 1
+    concurrency_group: Optional[str] = None
+
+    def instantiate(
+        self,
+        task_id: TaskID,
+        args: List[Tuple[str, Any]],
+        kwargs: List[Tuple[str, str, Any]],
+        return_ids: List[ObjectID],
+        deadline_remaining_s: Optional[float] = None,
+        seq_no: int = 0,
+    ) -> TaskSpec:
+        """Splice per-call fields into a full TaskSpec. Invariant fields
+        are SHARED (same dict/strategy objects across calls) — nothing
+        downstream may mutate them in place."""
+        return TaskSpec(
+            kind=self.kind,
+            task_id=task_id,
+            job_id=self.job_id,
+            name=self.name,
+            function_id=self.function_id,
+            args=args,
+            kwargs=kwargs,
+            num_returns=self.num_returns,
+            return_ids=return_ids,
+            resources=self.resources,
+            scheduling_strategy=self.scheduling_strategy,
+            owner=self.owner,
+            max_retries=self.max_retries,
+            retry_exceptions=self.retry_exceptions,
+            runtime_env=self.runtime_env,
+            deadline_remaining_s=deadline_remaining_s,
+            actor_id=self.actor_id,
+            max_concurrency=self.max_concurrency,
+            method_name=self.method_name,
+            seq_no=seq_no,
+            concurrency_group=self.concurrency_group,
+            template_id=self.template_id,
+        )
+
+    def from_percall(self, pc: tuple) -> TaskSpec:
+        return self.instantiate(
+            TaskID(pc[0]),
+            pc[1],
+            pc[2],
+            [ObjectID(b) for b in pc[3]],
+            deadline_remaining_s=pc[4],
+            seq_no=pc[5],
+        )
+
+
+def encode_spec(spec: TaskSpec):
+    """Wire encoding for task pushes: template-spliced specs travel as
+    ``("t", template_id, per-call-tuple)``; everything else as the full
+    spec (actor creation, .options() overrides, streaming)."""
+    if spec.template_id is None:
+        return spec
+    return (
+        "t",
+        spec.template_id,
+        (
+            spec.task_id.binary(),
+            spec.args,
+            spec.kwargs,
+            [o.binary() for o in spec.return_ids],
+            spec.deadline_remaining_s,
+            spec.seq_no,
+        ),
+    )

@@ -20,7 +20,9 @@ def test_cancel_queued_task(cluster):
 
     @ray_tpu.remote(num_cpus=1)
     def hog():
-        time.sleep(8)
+        # long enough that the victim is still lease-parked when the
+        # cancel lands (0.5s in) — 5s keeps slack without burning wall
+        time.sleep(5)
         return "done"
 
     @ray_tpu.remote(num_cpus=1)

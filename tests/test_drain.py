@@ -171,7 +171,10 @@ def test_drained_actor_restart_consumes_no_budget():
 def test_drain_flushes_objects_off_node():
     """Primary copies on a drained node are replicated to a peer and
     remain gettable afterwards WITHOUT lineage reconstruction (the
-    producing task cannot re-run: it was a one-shot put)."""
+    producing task cannot re-run: it was a one-shot put). INLINE results
+    take the opposite path: they never enter the relocation machinery —
+    the directory holds nothing for them and is never consulted; get()
+    answers from the owner-side inline cache after the node is gone."""
     cluster = Cluster(num_cpus=1)
     n2 = cluster.add_node(num_cpus=2, resources={"pin": 2})
     time.sleep(1.0)
@@ -183,11 +186,21 @@ def test_drain_flushes_objects_off_node():
             # large enough to live in shm (not inlined in the reply)
             return bytes([i]) * (512 * 1024)
 
+        @ray_tpu.remote(num_cpus=0, resources={"pin": 1}, max_retries=0)
+        def small(i):
+            return bytes([i]) * 64  # inline: rides back in the reply
+
         nid = [
             n["NodeID"] for n in ray_tpu.nodes() if "pin" in n["Resources"]
         ][0]
         refs = [big_block.remote(i) for i in range(4)]
-        ray_tpu.wait(refs, num_returns=len(refs), timeout=120, fetch_local=False)
+        inline_refs = [small.remote(i) for i in range(4)]
+        ray_tpu.wait(
+            refs + inline_refs,
+            num_returns=len(refs) + len(inline_refs),
+            timeout=120,
+            fetch_local=False,
+        )
         assert ray_tpu.drain_node(nid, "test: object flush")
         _wait(lambda: n2.poll() is not None, timeout=40, msg="daemon exits")
         # max_retries=0: lineage reconstruction is OFF for these tasks —
@@ -195,6 +208,31 @@ def test_drain_flushes_objects_off_node():
         vals = ray_tpu.get(refs, timeout=120)
         assert [v[:1] for v in vals] == [bytes([i]) for i in range(4)]
         assert all(len(v) == 512 * 1024 for v in vals)
+        # inline results: nothing was replicated for these ids…
+        from ray_tpu.core.api import _global_worker
+
+        core = _global_worker().backend
+        for r in inline_refs:
+            assert (
+                core.io.run(
+                    core.controller.call(
+                        "get_relocated", {"object_id": r.id().binary()}, timeout=10
+                    )
+                )
+                is None
+            )
+
+        def relocated_consults():
+            stats = core.io.run(core.controller.call("event_stats", None, timeout=10))
+            return stats["handlers"].get("get_relocated", {}).get("count", 0)
+
+        # …and their gets are served from the owner inline cache without
+        # a single relocation-directory consult
+        before = relocated_consults()
+        assert ray_tpu.get(inline_refs, timeout=60) == [
+            bytes([i]) * 64 for i in range(4)
+        ]
+        assert relocated_consults() == before
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()

@@ -69,6 +69,64 @@ def test_borrower_task_recovers_lost_dependency():
         cluster.shutdown()
 
 
+def test_inline_results_across_node_loss_and_reconstruction():
+    """Inline results cross the failure paths without reconstruction:
+    (a) a small (inlined) result survives losing its producing node with
+    retries exhausted — it lives in the OWNER's inline cache; (b) a
+    large (shm) result IS reconstructed after the node dies, and its
+    INLINED dependency is served from the owner cache — the dependency's
+    producing task must NOT re-run (inline values are always-available
+    to lineage reconstruction)."""
+    import tempfile
+
+    cluster, n2 = _make_cluster()
+    marker = tempfile.mktemp(prefix="raytpu-inline-dep-")
+    try:
+
+        @ray_tpu.remote(resources={"pin": 1}, num_cpus=0, max_retries=0)
+        def small():
+            return b"inline-payload" * 8  # far under the inline threshold
+
+        @ray_tpu.remote(num_cpus=1)
+        def small_dep(path):
+            with open(path, "ab") as f:
+                f.write(b"x")  # side-effect counter: one byte per run
+            return 7
+
+        @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
+        def big_from(dep):
+            return np.full(1 << 20, dep, dtype=np.uint8)
+
+        inline_ref = small.remote()
+        dep_ref = small_dep.remote(marker)
+        big_ref = big_from.remote(dep_ref)
+        ready, _ = ray_tpu.wait(
+            [inline_ref, big_ref], num_returns=2, timeout=120, fetch_local=False
+        )
+        assert len(ready) == 2
+        cluster.remove_node(n2)
+        cluster.add_node(num_cpus=2, resources={"pin": 2})
+        time.sleep(1.0)
+        # (a) inline result: max_retries=0, so only the owner's inline
+        # copy can satisfy this — no reconstruction possible or needed
+        assert ray_tpu.get(inline_ref, timeout=60) == b"inline-payload" * 8
+        # (b) shm result: reconstructs big_from only; the inlined dep is
+        # served from the owner cache
+        out = ray_tpu.get(big_ref, timeout=120)
+        assert out[0] == 7 and out.sum() == 7 * (1 << 20)
+        with open(marker, "rb") as f:
+            assert f.read() == b"x", "inlined dependency was re-executed"
+    finally:
+        import os as _os
+
+        try:
+            _os.unlink(marker)
+        except OSError:
+            pass
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def test_put_object_loss_raises_object_lost():
     """put() objects have no lineage: losing every copy surfaces
     ObjectLostError instead of hanging in a recovery loop."""

@@ -143,6 +143,132 @@ def test_retry_reconnects(io):
     io.run(s2.stop())
 
 
+def _parse_wire(wire):
+    """Parse raw wire bytes into (n_frames, messages-in-order)."""
+    import msgpack
+
+    from ray_tpu.core import rpc
+
+    frames = []
+    off = 0
+    while off < len(wire):
+        (ln,) = rpc._LEN.unpack_from(wire, off)
+        off += rpc._LEN.size
+        frames.append(msgpack.unpackb(wire[off : off + ln], raw=True, use_list=True))
+        off += ln
+    msgs = [m for f in frames for m in rpc._iter_messages(f)]
+    return frames, msgs
+
+
+def test_batch_wire_coalesces_and_preserves_fifo():
+    """Micro-batching wire form: one flush of N frames becomes one BATCH
+    frame; expansion yields the messages in exactly the queued order."""
+    from ray_tpu.core import rpc
+
+    bodies = [
+        rpc._encode_body(rpc.REQUEST, i, b"m", b"p%d" % i) for i in range(10)
+    ]
+    frames, msgs = _parse_wire(rpc._wire_from_bodies(bodies))
+    assert len(frames) == 1
+    assert frames[0][0] == rpc.BATCH
+    assert [m[1] for m in msgs] == list(range(10))
+    assert [bytes(m[3]) for m in msgs] == [b"p%d" % i for i in range(10)]
+    # a single queued frame travels plain (no batch wrapper)
+    frames1, msgs1 = _parse_wire(rpc._wire_from_bodies(bodies[:1]))
+    assert len(frames1) == 1 and frames1[0][0] == rpc.REQUEST
+
+
+def test_batch_wire_respects_caps():
+    """rpc_batch_max_frames / rpc_batch_max_bytes split a flush into
+    several batch frames, still in FIFO order; singleton groups travel
+    as plain frames."""
+    from ray_tpu.core import rpc
+
+    bodies = [
+        rpc._encode_body(rpc.REQUEST, i, b"m", b"x" * 10) for i in range(10)
+    ]
+    old_frames = GLOBAL_CONFIG.rpc_batch_max_frames
+    old_bytes = GLOBAL_CONFIG.rpc_batch_max_bytes
+    try:
+        GLOBAL_CONFIG.rpc_batch_max_frames = 4
+        frames, msgs = _parse_wire(rpc._wire_from_bodies(bodies))
+        assert [f[0] for f in frames] == [rpc.BATCH, rpc.BATCH, rpc.BATCH]
+        assert [len(list(rpc._iter_messages(f))) for f in frames] == [4, 4, 2]
+        assert [m[1] for m in msgs] == list(range(10))
+        # byte cap of 1: every body overflows the group → all plain frames
+        GLOBAL_CONFIG.rpc_batch_max_frames = 64
+        GLOBAL_CONFIG.rpc_batch_max_bytes = 1
+        frames, msgs = _parse_wire(rpc._wire_from_bodies(bodies))
+        assert [f[0] for f in frames] == [rpc.REQUEST] * 10
+        assert [m[1] for m in msgs] == list(range(10))
+    finally:
+        GLOBAL_CONFIG.rpc_batch_max_frames = old_frames
+        GLOBAL_CONFIG.rpc_batch_max_bytes = old_bytes
+
+
+def test_batched_dispatch_order_end_to_end(io):
+    """Requests issued in one loop pass coalesce into batch frames; the
+    server must enter their handlers in submission (FIFO) order."""
+    order = []
+
+    async def setup():
+        server = RpcServer()
+
+        async def note(payload, ctx):
+            order.append(payload)  # appended before any await → dispatch order
+            return payload
+
+        server.register("note", note)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    client = RpcClient("127.0.0.1", port)
+
+    async def many():
+        return await asyncio.gather(*[client.call("note", i) for i in range(100)])
+
+    assert io.run(many()) == list(range(100))
+    assert order == list(range(100))
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_batch_chaos_retries_without_duplicate_side_effects(io):
+    """Injected failures fire BEFORE the handler runs (rpc_chaos
+    contract), so a batch frame that dies mid-flight retries without
+    duplicating side effects — every op lands exactly once."""
+    counts = {}
+
+    async def setup():
+        server = RpcServer()
+
+        async def incr(payload, ctx):
+            counts[payload] = counts.get(payload, 0) + 1
+            return payload
+
+        server.register("incr", incr)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    client = RpcClient("127.0.0.1", port)
+    GLOBAL_CONFIG.testing_rpc_failure = "incr:0.3"
+    try:
+
+        async def many():
+            return await asyncio.gather(
+                *[client.call("incr", i, retries=100) for i in range(40)]
+            )
+
+        assert sorted(io.run(many())) == list(range(40))
+    finally:
+        GLOBAL_CONFIG.testing_rpc_failure = ""
+    assert {k: v for k, v in counts.items() if v != 1} == {}
+    io.run(client.close())
+    io.run(server.stop())
+
+
 def test_chaos_injection(io):
     async def setup():
         server = RpcServer()
