@@ -185,6 +185,84 @@ def bench_runtime(results: Dict[str, Dict]) -> None:
     ray_tpu.shutdown()
 
 
+def bench_serve_llm(results: Dict[str, Dict]) -> None:
+    """LLM serving engine on the toy config, measured through the FULL
+    serve streaming path (router dispatch + streaming generator + engine
+    continuous batching) — the number a serving deployment would see,
+    not the bare decode-step rate. CPU-runnable; on the real chip the
+    same harness reports chip decode throughput."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.inference.engine import EngineConfig
+    from ray_tpu.models.llama import LlamaConfig
+
+    ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 4)))
+    try:
+        ec = EngineConfig(
+            num_blocks=64, block_size=8, prefill_buckets=(8, 16, 32),
+            decode_buckets=(1, 2, 4, 8), max_decode_batch=8,
+        )
+        dep = serve.llm_deployment(LlamaConfig.tiny(), engine=ec)
+        handle = serve.run(dep.bind())
+        # warmup: bucket compiles happened at replica init; run one
+        # stream so the router/streaming path is warm too
+        list(handle.stream(
+            {"prompt": [1, 2, 3], "max_new_tokens": 4},
+            _method="generate", _timeout=300,
+        ))
+
+        n, new_tokens = 8, 32
+        ttfts: list = []
+        counts: list = []
+        lock = threading.Lock()
+
+        def consume(i: int) -> None:
+            t0 = time.perf_counter()
+            first = None
+            c = 0
+            for _ in handle.stream(
+                {"prompt": [1 + i, 2, 3, 4 + i], "max_new_tokens": new_tokens},
+                _method="generate", _timeout=300,
+            ):
+                if first is None:
+                    first = time.perf_counter() - t0
+                c += 1
+            with lock:
+                if first is not None:
+                    ttfts.append(first)
+                counts.append(c)
+
+        start = time.perf_counter()
+        threads = [threading.Thread(target=consume, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        total = sum(counts)
+        results["serve_llm_tokens_per_s"] = {
+            "value": round(total / wall, 2),
+            "unit": f"tokens/s (toy config, {n} concurrent streams)",
+        }
+        if ttfts:
+            p50, p99 = _percentiles(ttfts, (0.50, 0.99))
+            results["serve_llm_ttft_p50_p99"] = {
+                "value": round(p50 * 1000, 1),
+                "p99": round(p99 * 1000, 1),
+                "unit": "ms",
+            }
+        for k in ("serve_llm_tokens_per_s", "serve_llm_ttft_p50_p99"):
+            if k in results:
+                print(f"  {k}: {results[k]}", file=sys.stderr, flush=True)
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+
 def _bench_chained(attn, q, k, v, iters: int = 30, reps: int = 5) -> float:
     """Seconds per attention call, with iterations CHAINED inside one jit
     (output feeds the next input) and a host readback as the sync point.
@@ -416,6 +494,12 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         results["runtime_error"] = {"error": repr(e)}
         print(f"runtime bench failed: {e!r}", file=sys.stderr, flush=True)
+    print("== serve LLM benchmarks ==", file=sys.stderr, flush=True)
+    try:
+        bench_serve_llm(results)
+    except Exception as e:  # noqa: BLE001
+        results["serve_llm_error"] = {"error": repr(e)}
+        print(f"serve llm bench failed: {e!r}", file=sys.stderr, flush=True)
     print("== TPU compute benchmarks ==", file=sys.stderr, flush=True)
     try:
         bench_tpu(results)
@@ -439,6 +523,13 @@ def main() -> None:
     if lat.get("value") is not None:
         runtime_ratios["submit_get_latency_p50_ms"] = lat["value"]
         runtime_ratios["submit_get_latency_p99_ms"] = lat.get("p99")
+    tps = results.get("serve_llm_tokens_per_s", {})
+    if tps.get("value") is not None:
+        runtime_ratios["serve_llm_tokens_per_s"] = tps["value"]
+    ttft = results.get("serve_llm_ttft_p50_p99", {})
+    if ttft.get("value") is not None:
+        runtime_ratios["serve_llm_ttft_p50_ms"] = ttft["value"]
+        runtime_ratios["serve_llm_ttft_p99_ms"] = ttft.get("p99")
     results["runtime_vs_baseline"] = runtime_ratios
 
     details_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAILS.json")

@@ -521,26 +521,52 @@ def set_global_worker(worker: Optional[Worker]) -> None:
     _worker = worker
 
 
+#: (path -> (computed_at_monotonic, sig)) — every ``.remote()`` carrying
+#: a working_dir/py_modules runtime_env asks for the tree signature; a
+#: stat-walk of the whole directory per SUBMIT is the dominant cost of
+#: runtime_env task loops. Within the TTL the cached signature answers
+#: instead; an edit is still re-shipped at most ``tree_signature_ttl_s``
+#: late (the reference accepts the same staleness in its working_dir
+#: upload cache). TTL 0 disables caching (tests / paranoid callers).
+_tree_sig_cache: Dict[str, Tuple[float, int]] = {}
+
+
 def _tree_signature(value) -> int:
     """Cheap change signature for runtime_env path values: hash of every
-    file's (relpath, mtime_ns, size). Non-path values signature as 0."""
+    file's (relpath, mtime_ns, size), cached per path for a short TTL.
+    Non-path values signature as 0."""
     paths = value if isinstance(value, (list, tuple)) else [value]
+    ttl = GLOBAL_CONFIG.tree_signature_ttl_s
+    now = time.monotonic()
     sig = 0
     for p in paths:
         if not isinstance(p, str) or not os.path.exists(p):
             continue
-        if os.path.isfile(p):
-            st = os.stat(p)
-            sig = hash((sig, p, st.st_mtime_ns, st.st_size))
-            continue
-        for root, dirs, files in os.walk(p):
-            dirs.sort()
-            for f in sorted(files):
-                try:
-                    st = os.stat(os.path.join(root, f))
-                except OSError:
-                    continue
-                sig = hash((sig, os.path.join(root, f), st.st_mtime_ns, st.st_size))
+        if ttl > 0:
+            cached = _tree_sig_cache.get(p)
+            if cached is not None and now - cached[0] < ttl:
+                sig = hash((sig, cached[1]))
+                continue
+        psig = _stat_walk_signature(p)
+        if ttl > 0:
+            _tree_sig_cache[p] = (now, psig)
+        sig = hash((sig, psig))
+    return sig
+
+
+def _stat_walk_signature(p: str) -> int:
+    sig = 0
+    if os.path.isfile(p):
+        st = os.stat(p)
+        return hash((p, st.st_mtime_ns, st.st_size))
+    for root, dirs, files in os.walk(p):
+        dirs.sort()
+        for f in sorted(files):
+            try:
+                st = os.stat(os.path.join(root, f))
+            except OSError:
+                continue
+            sig = hash((sig, os.path.join(root, f), st.st_mtime_ns, st.st_size))
     return sig
 
 

@@ -164,6 +164,14 @@ class GlobalConfig:
     #: so consumers re-fetch instead of paying lineage reconstruction
     drain_flush_objects: bool = True
 
+    # --- runtime_env ---
+    #: TTL on the driver-side working_dir/py_modules change-signature
+    #: cache: within this window a .remote() carrying a runtime_env
+    #: reuses the cached tree signature instead of stat-walking the
+    #: whole directory per submit. An edit re-ships at most this many
+    #: seconds late. 0 disables the cache (walk every submit).
+    tree_signature_ttl_s: float = 5.0
+
     # --- RPC ---
     #: frames per coalesced batch frame on a connection flush (RPC
     #: micro-batching): a flush packs up to this many queued frames into

@@ -1,0 +1,54 @@
+"""ray_tpu.inference — TPU-native LLM inference engine.
+
+Continuous batching over a paged KV cache (vLLM-style), with bucketed
+fixed-shape jitted prefill/decode steps, admission control, priority
+preemption, and streaming Serve integration:
+
+    from ray_tpu import serve
+    from ray_tpu.inference import EngineConfig, llm_deployment
+
+    handle = serve.run(llm_deployment(model_cfg, engine=EngineConfig()).bind())
+    for tok in handle.stream({"prompt": [1, 2, 3]}, _method="generate"):
+        ...
+
+Submodules import lazily (PEP 562): ``kv_cache`` and ``scheduler`` are
+pure python, but ``engine``/``model_runner`` pull in jax — control-plane
+processes importing ``ray_tpu.inference`` for the scheduler must not pay
+for (or require) a working jax.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "PagedBlockManager": "ray_tpu.inference.kv_cache",
+    "ContinuousBatchingScheduler": "ray_tpu.inference.scheduler",
+    "Request": "ray_tpu.inference.scheduler",
+    "StepPlan": "ray_tpu.inference.scheduler",
+    "EngineConfig": "ray_tpu.inference.engine",
+    "InferenceEngine": "ray_tpu.inference.engine",
+    "EngineDrainingError": "ray_tpu.inference.engine",
+    "RequestFailedError": "ray_tpu.inference.engine",
+    "PagedModelRunner": "ray_tpu.inference.model_runner",
+    "llm_deployment": "ray_tpu.inference.serve_llm",
+    "LLMServer": "ray_tpu.inference.serve_llm",
+}
+
+# jax-free names only: star-imports resolve every __all__ entry through
+# __getattr__, and engine/model_runner/serve_llm pull in jax — the same
+# hazard serve.__all__ guards against. The jax-backed names stay
+# reachable by attribute.
+__all__ = [
+    "PagedBlockManager",
+    "ContinuousBatchingScheduler",
+    "Request",
+    "StepPlan",
+]
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

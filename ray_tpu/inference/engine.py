@@ -1,0 +1,646 @@
+"""InferenceEngine: continuous-batching autoregressive generation.
+
+One engine per replica/process. A background step-loop thread drives
+``step()``: each step runs at most one prefill chunk plus the standing
+decode batch (``scheduler.StepPlan``), samples the new tokens host-side,
+and pushes them into per-request queues that :meth:`generate` drains —
+so tokens stream to the caller WHILE other requests keep decoding.
+
+Request lifecycle hooks the rest of the runtime:
+
+* **deadlines** — ``submit`` captures the ambient ``core.deadline``
+  budget (propagated onto TaskSpecs by the runtime, so a serve caller's
+  timeout reaches the replica); the scheduler fails requests the step
+  after their budget expires instead of decoding dead tokens.
+* **drain** — ``begin_drain()`` stops admission and lets in-flight work
+  finish inside ``drain_grace_s``; wired to the node DRAINING push via
+  :meth:`attach_node_drain_listener` so a preemption warning on the
+  replica's node stops new work without erroring live streams.
+* **observability** — TTFT / tokens-per-second / cache-utilization /
+  queue-depth gauges through ``observability.metrics`` and a per-step
+  ``timeline`` profile event (chrome://tracing shows prefill/decode
+  interleave per step).
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.deadline import Deadline, remaining as deadline_remaining
+from ray_tpu.inference.kv_cache import PagedBlockManager
+from ray_tpu.inference.scheduler import (
+    CANCELLED,
+    DECODE,
+    FAILED,
+    FINISHED,
+    ContinuousBatchingScheduler,
+    Request,
+)
+from ray_tpu.observability import timeline
+
+_END = object()  # stream sentinel
+
+
+class EngineDrainingError(RuntimeError):
+    """New request rejected because the engine is draining."""
+
+
+class RequestFailedError(RuntimeError):
+    """The engine gave up on a request (deadline expiry, drain cutoff)."""
+
+
+@dataclass
+class EngineConfig:
+    """Knobs for the paged-KV continuous-batching engine (see README
+    "inference" section)."""
+
+    #: device block pool size (block 0 is the reserved null block)
+    num_blocks: int = 128
+    #: token positions per block
+    block_size: int = 16
+    #: prefill chunk-length buckets; one XLA program compiles per bucket.
+    #: None → derived from the model's max_seq_len (powers of two).
+    prefill_buckets: Optional[Sequence[int]] = None
+    #: decode batch-size buckets; None → (1, 2, 4, ..., max_decode_batch)
+    decode_buckets: Optional[Sequence[int]] = None
+    max_decode_batch: int = 8
+    #: prefill chunks per engine step (prefill rides WITH the decode batch)
+    max_prefills_per_step: int = 1
+    #: admission queue bound: submits beyond this fail fast
+    max_queue_depth: int = 128
+    #: compile every bucket at startup so serving never eats a compile
+    warmup: bool = True
+    #: default cap on generated tokens per request
+    max_new_tokens_default: int = 64
+    #: KV cache dtype override (None → model dtype)
+    cache_dtype: Any = None
+    #: reap finished-but-never-drained token streams after this long; a
+    #: caller that submits and walks away (own deadline hit, gave up
+    #: after a tokens() timeout without cancel()) would otherwise pin its
+    #: queue in the replica forever. <= 0 disables.
+    finished_stream_ttl_s: float = 300.0
+
+    def resolved_prefill_buckets(self, max_seq_len: int) -> Sequence[int]:
+        if self.prefill_buckets is not None:
+            return tuple(sorted(self.prefill_buckets))
+        out, b = [], 16
+        while b < max_seq_len:
+            out.append(b)
+            b *= 2
+        out.append(max_seq_len)
+        return tuple(out)
+
+    def resolved_decode_buckets(self) -> Sequence[int]:
+        if self.decode_buckets is not None:
+            return tuple(sorted(self.decode_buckets))
+        out, b = [], 1
+        while b < self.max_decode_batch:
+            out.append(b)
+            b *= 2
+        out.append(self.max_decode_batch)
+        return tuple(sorted(set(out)))
+
+
+# -- engine metrics (registered once per process; re-registration of the
+# same names returns the shared underlying metric) --------------------------
+
+
+def _engine_metrics():
+    from ray_tpu.observability.metrics import Counter, Gauge
+
+    return {
+        "ttft": Gauge(
+            "raytpu_llm_ttft_seconds",
+            "time from request submit to first streamed token",
+            ("quantile",),
+        ),
+        "tps": Gauge(
+            "raytpu_llm_tokens_per_s",
+            "decode throughput over the trailing window",
+        ),
+        "cache_util": Gauge(
+            "raytpu_llm_kv_cache_utilization",
+            "fraction of usable KV blocks currently allocated",
+        ),
+        "queue_depth": Gauge(
+            "raytpu_llm_queue_depth", "requests waiting for admission"
+        ),
+        "active": Gauge("raytpu_llm_active_requests", "admitted, unfinished"),
+        "decode_batch": Gauge(
+            "raytpu_llm_decode_batch_size", "slots in the last decode step"
+        ),
+        "tokens_total": Counter(
+            "raytpu_llm_tokens_generated_total", "tokens sampled"
+        ),
+        "requests_total": Counter(
+            "raytpu_llm_requests_total", "requests by terminal state", ("outcome",)
+        ),
+        "preemptions_total": Counter(
+            "raytpu_llm_preemptions_total", "requests evicted for blocks"
+        ),
+    }
+
+
+class InferenceEngine:
+    def __init__(self, model_cfg, params, engine_cfg: Optional[EngineConfig] = None):
+        from ray_tpu.inference.model_runner import PagedModelRunner
+
+        self.cfg = model_cfg
+        self.engine_cfg = ec = engine_cfg or EngineConfig()
+        decode_buckets = ec.resolved_decode_buckets()
+        if ec.max_decode_batch > max(decode_buckets):
+            # catching this at runtime instead means _round_up_bucket
+            # raises inside step() and _fail_all errors every in-flight
+            # request, repeatedly — fail loud at init instead
+            raise ValueError(
+                f"max_decode_batch={ec.max_decode_batch} exceeds the largest "
+                f"decode bucket {max(decode_buckets)}; add a bucket >= the "
+                "batch cap or lower max_decode_batch"
+            )
+        self.runner = PagedModelRunner(
+            model_cfg,
+            params,
+            num_blocks=ec.num_blocks,
+            block_size=ec.block_size,
+            prefill_buckets=ec.resolved_prefill_buckets(model_cfg.max_seq_len),
+            decode_buckets=decode_buckets,
+            cache_dtype=ec.cache_dtype,
+        )
+        self.blocks = PagedBlockManager(ec.num_blocks, ec.block_size)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.blocks,
+            max_decode_batch=ec.max_decode_batch,
+            max_prefill_chunk=max(ec.resolved_prefill_buckets(model_cfg.max_seq_len)),
+            max_prefills_per_step=ec.max_prefills_per_step,
+            max_queue_depth=ec.max_queue_depth,
+        )
+        self._out: Dict[str, queue.Queue] = {}
+        self._rngs: Dict[str, np.random.RandomState] = {}
+        self._submitted_at: Dict[str, float] = {}
+        self._first_token_at: Dict[str, float] = {}
+        self._finished_at: Dict[str, float] = {}
+        self._next_stream_reap = 0.0
+        self._next_gauge_refresh = 0.0
+        self._lock = threading.RLock()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._draining = False
+        self._drain_deadline: Optional[Deadline] = None
+        self._listener_backend = None
+        self._node_listener = None
+        self.metrics = _engine_metrics()
+        self._ttfts: deque = deque(maxlen=512)
+        self._token_times: deque = deque(maxlen=2048)
+        self._preempt_seen = 0
+        self.total_steps = 0
+        if ec.warmup:
+            self.runner.warmup()
+        else:
+            self.runner.mark_warm()
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "InferenceEngine":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="llm-engine-step"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        # the step loop is dead: queued/running requests can never emit
+        # another token — fail them so callers blocked in tokens() wake
+        # instead of hanging on q.get() forever
+        self._fail_all(RequestFailedError("engine stopped"))
+        self.detach_node_drain_listener()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            did_work = False
+            try:
+                did_work = self.step()
+            except Exception as e:  # noqa: BLE001 — fail in-flight, keep serving
+                self._fail_all(e)
+            self._reap_abandoned_streams()
+            if not did_work:
+                self._work.wait(timeout=0.005)
+                self._work.clear()
+
+    # -- submission -------------------------------------------------------
+    def submit(
+        self,
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: Optional[int] = None,
+        temperature: float = 0.0,
+        priority: int = 0,
+        eos_token: Optional[int] = None,
+        request_id: Optional[str] = None,
+        seed: Optional[int] = None,
+        timeout_s: Optional[float] = None,
+    ) -> str:
+        """Enqueue a generation request; returns its id. The ambient
+        ``core.deadline`` budget (or explicit ``timeout_s``, whichever is
+        tighter) bounds the request end to end."""
+        if self._draining or not self.scheduler.admitting:
+            raise EngineDrainingError("engine is draining: not admitting requests")
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens is None:
+            max_new = self.engine_cfg.max_new_tokens_default
+        else:
+            max_new = int(max_new_tokens)
+            if max_new < 1:
+                raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        # clamp so prompt + generation always fits the block-table width
+        room = self.cfg.max_seq_len - len(prompt)
+        if room < 1:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens >= max_seq_len {self.cfg.max_seq_len}"
+            )
+        max_new = min(max_new, room)
+        rid = request_id or uuid.uuid4().hex[:16]
+        budget = deadline_remaining()
+        if timeout_s is not None:
+            budget = timeout_s if budget is None else min(budget, timeout_s)
+        req = Request(
+            request_id=rid,
+            prompt=prompt,
+            max_new_tokens=max_new,
+            priority=priority,
+            temperature=temperature,
+            eos_token=eos_token,
+            deadline=Deadline.after(budget) if budget is not None else None,
+            seed=seed,
+        )
+        with self._lock:
+            if rid in self._out:
+                raise ValueError(f"duplicate request_id {rid!r}")
+            self._out[rid] = queue.Queue()
+            if temperature > 0.0:
+                self._rngs[rid] = np.random.RandomState(
+                    seed if seed is not None else (hash(rid) & 0x7FFFFFFF)
+                )
+            self._submitted_at[rid] = time.monotonic()
+        try:
+            self.scheduler.add(req)
+        except Exception:
+            with self._lock:
+                self._out.pop(rid, None)
+                self._rngs.pop(rid, None)
+                self._submitted_at.pop(rid, None)
+            raise
+        self._work.set()
+        return rid
+
+    def generate(
+        self,
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: Optional[int] = None,
+        temperature: float = 0.0,
+        priority: int = 0,
+        eos_token: Optional[int] = None,
+        request_id: Optional[str] = None,
+        seed: Optional[int] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Iterator[int]:
+        """Submit and stream tokens as they decode. Closing/abandoning
+        the iterator cancels the request and frees its blocks."""
+        rid = self.submit(
+            prompt,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            priority=priority,
+            eos_token=eos_token,
+            request_id=request_id,
+            seed=seed,
+            timeout_s=timeout_s,
+        )
+        try:
+            yield from self.tokens(rid)
+        finally:
+            self.cancel(rid)  # no-op when already finished
+
+    def tokens(self, request_id: str, timeout: Optional[float] = None) -> Iterator[int]:
+        """Drain a submitted request's token stream. ``timeout`` bounds
+        each inter-token gap: on expiry a :class:`TimeoutError` is raised
+        but the request keeps running and the stream stays resumable —
+        call ``tokens()`` again to continue, or ``cancel()`` to give up."""
+        q = self._out.get(request_id)
+        if q is None:
+            raise KeyError(f"unknown request {request_id!r}")
+        drop = True
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=timeout) if timeout is not None else q.get()
+                except queue.Empty:
+                    drop = False
+                    raise TimeoutError(
+                        f"no token within {timeout}s for request {request_id!r}; "
+                        "still running — retry tokens() or cancel()"
+                    ) from None
+                if item is _END:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            # stream consumed (or abandoned): drop the queue — except on
+            # inter-token timeout, where the request is still decoding and
+            # a retry must find the queue (popping here would silently
+            # drop every later token and KeyError the retry)
+            if drop:
+                with self._lock:
+                    self._out.pop(request_id, None)
+                    self._finished_at.pop(request_id, None)
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a queued/running request; frees its blocks. Returns
+        True if something was actually cancelled."""
+        req = self.scheduler.cancel(request_id)
+        if req is None:
+            # already finished (or unknown). The finish may still be
+            # mid-flight on the step thread — scheduler.finish() done but
+            # _finish_request() not yet run — so popping the queue alone
+            # could strand a consumer blocked in q.get() with no _END
+            # ever arriving. Wake it, then drop the dict entry.
+            with self._lock:
+                q = self._out.pop(request_id, None)
+                self._finished_at.pop(request_id, None)
+            if q is not None:
+                q.put(_END)
+            return False
+        self._finish_request(req, CANCELLED, error=None)
+        return True
+
+    # -- drain ------------------------------------------------------------
+    def begin_drain(self, grace_s: Optional[float] = None) -> None:
+        """Stop admitting; in-flight (queued + running) requests keep
+        decoding until done or the grace window closes, after which the
+        stragglers fail with :class:`RequestFailedError`."""
+        grace = GLOBAL_CONFIG.drain_grace_s if grace_s is None else grace_s
+        with self._lock:
+            self._draining = True
+            self.scheduler.admitting = False
+            self._drain_deadline = Deadline.after(grace)
+        self._work.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def attach_node_drain_listener(self) -> None:
+        """Subscribe to node DRAINING pushes: a preemption warning on OUR
+        node triggers ``begin_drain`` (serve unroutes the replica at the
+        same time, so live streams finish and nothing new arrives)."""
+        try:
+            import ray_tpu
+            from ray_tpu.core.api import _global_worker
+
+            my_node = ray_tpu.get_runtime_context().get_node_id()
+            backend = _global_worker().backend
+        except Exception:
+            return  # local mode / no cluster: explicit begin_drain() only
+
+        def _on_node_event(msg: Dict[str, Any]) -> None:
+            nid = msg.get("node_id")
+            nid = nid.hex() if isinstance(nid, bytes) else nid
+            if msg.get("state") == "DRAINING" and nid == my_node:
+                self.begin_drain()
+
+        try:
+            backend.add_node_event_listener(_on_node_event)
+        except Exception:
+            return
+        self._listener_backend = backend
+        self._node_listener = _on_node_event
+
+    def detach_node_drain_listener(self) -> None:
+        if self._listener_backend is not None and self._node_listener is not None:
+            try:
+                self._listener_backend.remove_node_event_listener(self._node_listener)
+            except Exception:
+                pass
+        self._listener_backend = None
+        self._node_listener = None
+
+    # -- the step ---------------------------------------------------------
+    def step(self) -> bool:
+        """One engine step: ≤N prefill chunks + the decode batch. Returns
+        whether any work ran."""
+        if self._draining and self._drain_deadline is not None and self._drain_deadline.expired:
+            self._fail_all(
+                RequestFailedError("engine drain grace expired mid-generation")
+            )
+        plan = self.scheduler.schedule()
+        for req in plan.reaped:
+            self._finish_request(
+                req,
+                req.state,
+                error=RequestFailedError(
+                    f"request {req.request_id} deadline expired before completion"
+                ),
+            )
+        if not plan.prefills and not plan.decodes:
+            return not plan.empty
+
+        # timeline timestamps share the module's wall-clock epoch so
+        # engine_step events merge with every other process's trace
+        t0_us = timeline._now_us()
+        n_prefill_tokens = 0
+        for req, start, chunk in plan.prefills:
+            row = self.blocks.table_row(req.request_id, self.runner.max_blocks_per_seq)
+            prompt = req.effective_prompt
+            logits = self.runner.prefill_chunk(
+                prompt[start : start + chunk], row, start
+            )
+            req.prefill_pos = start + chunk
+            n_prefill_tokens += chunk
+            if req.prefill_done:
+                req.state = DECODE
+                self._emit_token(req, self._sample(req, logits))
+
+        if plan.decodes:
+            toks = [r.generated[-1] for r in plan.decodes]
+            poss = [r.context_len - 1 for r in plan.decodes]
+            rows = [
+                self.blocks.table_row(r.request_id, self.runner.max_blocks_per_seq)
+                for r in plan.decodes
+            ]
+            cls = [r.context_len for r in plan.decodes]
+            logits = self.runner.decode(toks, poss, rows, cls)
+            for req, lg in zip(plan.decodes, logits):
+                self._emit_token(req, self._sample(req, lg))
+        self.total_steps += 1
+        timeline.record_event(
+            "engine_step",
+            "inference",
+            t0_us,
+            timeline._now_us(),
+            args={
+                "prefill_tokens": n_prefill_tokens,
+                "decode_batch": len(plan.decodes),
+            },
+        )
+        self._update_gauges(len(plan.decodes))
+        return True
+
+    # -- internals --------------------------------------------------------
+    def _sample(self, req: Request, logits: np.ndarray) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        rng = self._rngs.get(req.request_id) or np.random.RandomState(0)
+        z = (logits / req.temperature).astype(np.float64)
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))
+
+    def _emit_token(self, req: Request, token: int) -> None:
+        if req.finished:
+            # cancelled/failed after this step's plan was built but before
+            # its token was sampled: emitting would stream a stray token
+            # and the done-path below would overwrite CANCELLED with
+            # FINISHED, double-counting requests_total
+            return
+        req.generated.append(token)
+        now = time.monotonic()
+        self._token_times.append(now)
+        self.metrics["tokens_total"].inc()
+        with self._lock:
+            q = self._out.get(req.request_id)
+            if req.request_id not in self._first_token_at:
+                self._first_token_at[req.request_id] = now
+                sub = self._submitted_at.get(req.request_id)
+                if sub is not None:
+                    self._ttfts.append(now - sub)
+        if q is not None:
+            q.put(token)
+        done = (
+            len(req.generated) >= req.max_new_tokens
+            or (req.eos_token is not None and token == req.eos_token)
+        )
+        if done and self.scheduler.finish(req, FINISHED):
+            # finish() returns False when cancel() won the race after the
+            # req.finished guard above — the cancel path already notified
+            # the waiter and counted the outcome
+            self._finish_request(req, FINISHED, error=None)
+
+    def _finish_request(self, req: Request, state: str, error: Optional[Exception]) -> None:
+        with self._lock:
+            q = self._out.get(req.request_id)
+            self._submitted_at.pop(req.request_id, None)
+            self._rngs.pop(req.request_id, None)
+            self._first_token_at.pop(req.request_id, None)
+            if q is not None:
+                # the queue stays for a late tokens() call; stamp it so an
+                # abandoned stream is reaped instead of pinned forever
+                self._finished_at[req.request_id] = time.monotonic()
+        if q is not None:
+            q.put(error if error is not None else _END)
+        outcome = {FINISHED: "finished", CANCELLED: "cancelled"}.get(state, "failed")
+        self.metrics["requests_total"].inc(labels={"outcome": outcome})
+
+    def _fail_all(self, error: Exception) -> None:
+        for req in self.scheduler.take_all():
+            self.blocks.free(req.request_id)
+            req.state = FAILED
+            self._finish_request(req, FAILED, error=error)
+
+    def _reap_abandoned_streams(self) -> None:
+        ttl = self.engine_cfg.finished_stream_ttl_s
+        if ttl <= 0:
+            return
+        now = time.monotonic()
+        if now < self._next_stream_reap:
+            return
+        self._next_stream_reap = now + min(ttl, 10.0)
+        with self._lock:
+            dead = [r for r, t in self._finished_at.items() if now - t > ttl]
+            for rid in dead:
+                self._finished_at.pop(rid, None)
+                self._out.pop(rid, None)
+
+    def _tokens_per_s(self) -> float:
+        # expired timestamps are dropped incrementally: the step loop calls
+        # this via _update_gauges, and a full copy-and-filter of the 2048-cap
+        # deque every step was measurable overhead at decode rates
+        now = time.monotonic()
+        tt = self._token_times
+        while tt and now - tt[0] > 10.0:
+            tt.popleft()
+        if len(tt) < 2:
+            return 0.0
+        span = max(now - tt[0], 1e-6)
+        return len(tt) / span
+
+    def _ttft_quantiles(self) -> Dict[str, float]:
+        if not self._ttfts:
+            return {}
+        xs = sorted(self._ttfts)
+        pick = lambda f: xs[min(len(xs) - 1, int(f * (len(xs) - 1)))]
+        return {"p50": pick(0.50), "p99": pick(0.99)}
+
+    def _update_gauges(self, decode_batch: int) -> None:
+        m = self.metrics
+        m["decode_batch"].set(decode_batch)
+        pre = self.scheduler.total_preempted - getattr(self, "_preempt_seen", 0)
+        if pre > 0:
+            m["preemptions_total"].inc(pre)
+        self._preempt_seen = self.scheduler.total_preempted
+        # the remaining gauges cost lock round-trips and a 512-entry sort
+        # (_ttft_quantiles) — at hundreds of steps/s that's pure step-loop
+        # overhead, so refresh them at 4 Hz (first step always publishes,
+        # so metric names appear on /metrics as soon as anything runs)
+        now = time.monotonic()
+        if now < self._next_gauge_refresh:
+            return
+        self._next_gauge_refresh = now + 0.25
+        m["cache_util"].set(self.blocks.utilization())
+        m["queue_depth"].set(self.scheduler.queue_depth())
+        m["active"].set(len(self.scheduler.running))
+        m["tps"].set(round(self._tokens_per_s(), 2))
+        for qname, v in self._ttft_quantiles().items():
+            m["ttft"].set(round(v, 6), labels={"quantile": qname})
+
+    # -- introspection ----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        s = {
+            "scheduler": self.scheduler.stats(),
+            "blocks": self.blocks.stats(),
+            "total_steps": self.total_steps,
+            "draining": self._draining,
+            "compile_count": self.runner.compile_count(),
+            "recompiles_after_warmup": self.runner.recompiles_after_warmup(),
+            "tokens_per_s": round(self._tokens_per_s(), 2),
+            "ttft": {k: round(v, 6) for k, v in self._ttft_quantiles().items()},
+        }
+        return s
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until no queued/running work remains (drain helper)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self.scheduler.has_work():
+                return True
+            time.sleep(0.005)
+        return not self.scheduler.has_work()

@@ -1,0 +1,141 @@
+"""Paged KV cache management: the host-side block allocator.
+
+vLLM-style paging (PAPERS.md: TPU serving stacks win by packing many
+requests into one fixed-shape KV cache): the device holds
+``[n_layers, num_blocks, block_size, n_kv_heads, head_dim]`` K and V
+tensors (``models.llama.init_paged_kv_cache``); this module owns the
+*accounting* — which request holds which block ids, what is free, and
+when a new request must wait in the admission queue instead.
+
+Block id 0 is reserved as the NULL block: padding positions in the
+fixed-shape prefill/decode steps write their trash there, so it is never
+handed to a request. Block ids are layer-agnostic — one id covers
+``block_size`` token positions in every layer at once, so the allocator
+deals in tokens, not layer-tokens.
+
+Pure host-side python with no jax dependency: unit-testable without an
+accelerator, and cheap enough to run under the engine lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class PagedBlockManager:
+    """Allocation / free / eviction accounting for the shared block pool."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the null block)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # block 0 = null: never allocated
+        self._free: deque = deque(range(1, num_blocks))
+        self._owned: Dict[str, List[int]] = {}
+        self._lock = threading.Lock()
+        # lifetime accounting (engine /metrics + stats())
+        self.total_allocs = 0
+        self.total_frees = 0
+        self.total_evictions = 0
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1  # minus the null block
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.usable_blocks - self.free_blocks
+
+    def utilization(self) -> float:
+        return self.used_blocks / max(1, self.usable_blocks)
+
+    def blocks_for_tokens(self, num_tokens: int) -> int:
+        return max(1, -(-num_tokens // self.block_size))  # ceil
+
+    # -- allocation -------------------------------------------------------
+    def owned(self, request_id: str) -> List[int]:
+        with self._lock:
+            return list(self._owned.get(request_id, ()))
+
+    def can_grow_to(self, request_id: str, num_tokens: int) -> bool:
+        """Whether the pool can extend ``request_id`` to cover
+        ``num_tokens`` total positions (no allocation happens)."""
+        need = self.blocks_for_tokens(num_tokens)
+        with self._lock:
+            have = len(self._owned.get(request_id, ()))
+            return need - have <= len(self._free)
+
+    def grow_to(self, request_id: str, num_tokens: int) -> bool:
+        """Extend the request's block list to cover ``num_tokens`` total
+        positions. All-or-nothing: returns False (nothing allocated) when
+        the free pool can't cover the extension."""
+        need = self.blocks_for_tokens(num_tokens)
+        with self._lock:
+            blocks = self._owned.setdefault(request_id, [])
+            missing = need - len(blocks)
+            if missing <= 0:
+                return True
+            if missing > len(self._free):
+                if not blocks:
+                    self._owned.pop(request_id, None)
+                return False
+            for _ in range(missing):
+                blocks.append(self._free.popleft())
+            self.total_allocs += missing
+            return True
+
+    def free(self, request_id: str) -> int:
+        """Return every block the request holds to the pool."""
+        with self._lock:
+            blocks = self._owned.pop(request_id, [])
+            self._free.extend(blocks)
+            self.total_frees += len(blocks)
+            return len(blocks)
+
+    def evict(self, request_id: str) -> int:
+        """Free-with-attitude: same as :meth:`free` but counted as a
+        preemption eviction (the scheduler took the blocks away; the
+        request re-prefills on readmission)."""
+        n = self.free(request_id)
+        if n:
+            self.total_evictions += 1
+        return n
+
+    def table_row(self, request_id: str, max_blocks: int) -> List[int]:
+        """The request's block-table row, right-padded with the null
+        block to the fixed ``max_blocks`` width the jitted steps expect."""
+        blocks = self.owned(request_id)
+        if len(blocks) > max_blocks:
+            raise ValueError(
+                f"request {request_id!r} holds {len(blocks)} blocks > "
+                f"max_blocks_per_seq {max_blocks}"
+            )
+        return blocks + [0] * (max_blocks - len(blocks))
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            free = len(self._free)
+            holders = len(self._owned)
+        used = self.usable_blocks - free
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "used_blocks": used,
+            "free_blocks": free,
+            "holders": holders,
+            "utilization": used / max(1, self.usable_blocks),
+            "total_allocs": self.total_allocs,
+            "total_frees": self.total_frees,
+            "total_evictions": self.total_evictions,
+        }

@@ -1,0 +1,168 @@
+"""Bucketed jitted prefill/decode steps over the paged KV cache.
+
+Fixed shapes are the whole game on TPU: XLA compiles one program per
+input shape, so the runner rounds every prefill chunk up to a length
+bucket and every decode batch up to a size bucket. After warmup the
+engine must see ZERO recompiles — the jit cache holds exactly one entry
+per bucket, asserted via ``recompiles_after_warmup()`` (backed by
+``PjitFunction._cache_size`` when jax exposes it, a shape-signature
+count otherwise).
+
+The device cache lives here as functional state: every step returns a
+new cache value and the runner swaps its reference — donation hands the
+buffer back on TPU (``donate_argnums``); on CPU/GPU test backends jax
+copies, which the toy config absorbs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    init_paged_kv_cache,
+    paged_decode_step,
+    paged_prefill_step,
+)
+
+
+def _round_up_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")
+
+
+class PagedModelRunner:
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params,
+        *,
+        num_blocks: int,
+        block_size: int,
+        prefill_buckets: Sequence[int],
+        decode_buckets: Sequence[int],
+        cache_dtype=None,
+    ):
+        import jax
+
+        self.cfg = cfg
+        self.params = params
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+        self.decode_buckets = tuple(sorted(decode_buckets))
+        #: fixed block-table width every request/table row pads to
+        self.max_blocks_per_seq = -(-cfg.max_seq_len // block_size)
+        if num_blocks - 1 < self.max_blocks_per_seq:
+            raise ValueError(
+                f"num_blocks={num_blocks} can't hold one max-length sequence "
+                f"({self.max_blocks_per_seq} blocks + null block)"
+            )
+        self.cache = init_paged_kv_cache(cfg, num_blocks, block_size, cache_dtype)
+
+        # donation returns the cache buffer in place on TPU; CPU would
+        # warn-and-copy, so only donate where it's real
+        donate = (2,) if jax.default_backend() == "tpu" else ()
+        self._prefill_jit = jax.jit(
+            partial(paged_prefill_step, cfg), donate_argnums=donate
+        )
+        self._decode_jit = jax.jit(
+            partial(paged_decode_step, cfg), donate_argnums=donate
+        )
+        self._seen_shapes: set = set()
+        self._warmup_compiles: Optional[int] = None
+
+    # -- compile accounting ----------------------------------------------
+    def _jit_cache_entries(self) -> int:
+        total = 0
+        for fn in (self._prefill_jit, self._decode_jit):
+            size = getattr(fn, "_cache_size", None)
+            if size is None:
+                return len(self._seen_shapes)
+            total += size()
+        return total
+
+    def mark_warm(self) -> None:
+        """Call after warmup: compiles past this point are regressions."""
+        self._warmup_compiles = self._jit_cache_entries()
+
+    def recompiles_after_warmup(self) -> int:
+        if self._warmup_compiles is None:
+            return 0
+        return max(0, self._jit_cache_entries() - self._warmup_compiles)
+
+    def compile_count(self) -> int:
+        return self._jit_cache_entries()
+
+    def warmup(self, buckets_prefill=None, buckets_decode=None) -> None:
+        """Compile every (or the given) bucket up front with trash inputs
+        aimed at the null block, then :meth:`mark_warm`."""
+        M = self.max_blocks_per_seq
+        for c in buckets_prefill if buckets_prefill is not None else self.prefill_buckets:
+            tokens = np.zeros(c, np.int32)
+            row = np.zeros(M, np.int32)
+            self.cache, _ = self._prefill_jit(
+                self.params, self.cache, tokens, row, np.int32(0), np.int32(0)
+            )
+            self._seen_shapes.add(("p", c))
+        for b in buckets_decode if buckets_decode is not None else self.decode_buckets:
+            self.cache, _ = self._decode_jit(
+                self.params,
+                self.cache,
+                np.zeros(b, np.int32),
+                np.zeros(b, np.int32),
+                np.zeros((b, M), np.int32),
+                np.ones(b, np.int32),
+            )
+            self._seen_shapes.add(("d", b))
+        self.mark_warm()
+
+    # -- steps ------------------------------------------------------------
+    def prefill_chunk(
+        self,
+        tokens: Sequence[int],
+        block_row: Sequence[int],
+        ctx_len: int,
+    ) -> np.ndarray:
+        """Run one prefill chunk; returns logits [vocab] (fp32 numpy) for
+        the chunk's last valid token."""
+        true_len = len(tokens)
+        bucket = _round_up_bucket(true_len, self.prefill_buckets)
+        padded = np.zeros(bucket, np.int32)
+        padded[:true_len] = tokens
+        row = np.asarray(block_row, np.int32)
+        self._seen_shapes.add(("p", bucket))
+        self.cache, logits = self._prefill_jit(
+            self.params, self.cache, padded, row,
+            np.int32(ctx_len), np.int32(true_len),
+        )
+        return np.asarray(logits)
+
+    def decode(
+        self,
+        tokens: Sequence[int],
+        positions: Sequence[int],
+        block_rows: Sequence[Sequence[int]],
+        ctx_lens: Sequence[int],
+    ) -> np.ndarray:
+        """Advance a decode batch one token; returns logits [n, vocab]
+        for the n REAL slots (padding stripped)."""
+        n = len(tokens)
+        bucket = _round_up_bucket(n, self.decode_buckets)
+        M = self.max_blocks_per_seq
+        t = np.zeros(bucket, np.int32)
+        p = np.zeros(bucket, np.int32)
+        bt = np.zeros((bucket, M), np.int32)
+        cl = np.ones(bucket, np.int32)  # padding slots: ctx=1 over the null block
+        t[:n] = tokens
+        p[:n] = positions
+        bt[:n] = np.asarray(block_rows, np.int32)
+        cl[:n] = ctx_lens
+        self._seen_shapes.add(("d", bucket))
+        self.cache, logits = self._decode_jit(self.params, self.cache, t, p, bt, cl)
+        return np.asarray(logits)[:n]

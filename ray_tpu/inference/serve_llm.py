@@ -1,0 +1,181 @@
+"""serve.llm-style deployment: the InferenceEngine behind Serve.
+
+``llm_deployment(...)`` returns a regular Serve :class:`Deployment`
+whose replicas each host one :class:`LLMServer` (engine + model params).
+Tokens stream to callers through the runtime's ``num_returns="streaming"``
+generator path and the Serve router/proxy:
+
+    from ray_tpu import serve
+    from ray_tpu.inference import EngineConfig, llm_deployment
+
+    dep = llm_deployment(LlamaConfig.tiny(), engine=EngineConfig(num_blocks=64))
+    handle = serve.run(dep.bind())
+    for tok in handle.stream({"prompt": [3, 7, 11], "max_new_tokens": 16},
+                             _method="generate"):
+        ...
+
+Per-request deadlines: the caller's timeout propagates onto the task
+spec (``core/deadline.py``) and the executing replica re-enters the
+budget, so ``LLMServer.generate`` submits with the remaining budget and
+the engine stops decoding for callers that already gave up. Node drain:
+each replica engine subscribes to the node DRAINING push — a preemption
+warning stops admission while Serve unroutes the replica and waits for
+the in-flight streams, so clients see completed generations, not errors.
+
+Retry semantics note: ``handle.call``/``router.execute`` are
+at-least-once — a replica death mid-call re-executes the generation on a
+survivor. Generation is NOT idempotent across replicas (fresh params =
+same tokens, but duplicated sampling work); callers that care should use
+``handle.stream`` (retries only before the first token) or pass a
+``request_id`` and dedupe downstream.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+
+class LLMServer:
+    """One replica: model params + continuous-batching engine.
+
+    Defined undecorated at module level so cloudpickle exports it by
+    reference (see serve/replica.py for the rationale).
+    """
+
+    def __init__(
+        self,
+        model_cfg=None,
+        engine_cfg=None,
+        *,
+        seed: int = 0,
+        params=None,
+        export_metrics: bool = True,
+    ):
+        import jax
+
+        from ray_tpu.core.config import GLOBAL_CONFIG
+        from ray_tpu.inference.engine import EngineConfig, InferenceEngine
+        from ray_tpu.models.llama import LlamaConfig, init_params
+
+        if model_cfg is None:
+            model_cfg = LlamaConfig.tiny()
+        self.model_cfg = model_cfg
+        if params is None:
+            params = init_params(model_cfg, jax.random.PRNGKey(seed))
+        self.engine = InferenceEngine(
+            model_cfg, params, engine_cfg or EngineConfig()
+        ).start()
+        self.engine.attach_node_drain_listener()
+        self._metrics_server = None
+        if export_metrics and GLOBAL_CONFIG.metrics_export_enabled:
+            # replicas run in worker processes, which don't host the
+            # daemon's /metrics endpoint — export the engine gauges from
+            # an auto-port server of our own (address via metrics_address)
+            from ray_tpu.observability.metrics import MetricsServer
+
+            self._metrics_server = MetricsServer(
+                host=GLOBAL_CONFIG.metrics_bind_host, port=0
+            )
+
+    # -- request plumbing -------------------------------------------------
+    @staticmethod
+    def _parse(request) -> Dict[str, Any]:
+        if isinstance(request, dict):
+            if "prompt" not in request:
+                raise ValueError("request dict needs a 'prompt' (list of token ids)")
+            return dict(request)
+        if isinstance(request, (list, tuple)):
+            return {"prompt": list(request)}
+        raise TypeError(
+            f"request must be a dict or token list, got {type(request).__name__}"
+        )
+
+    def generate(self, request) -> Iterator[int]:
+        """Streaming entry (call with ``num_returns="streaming"`` /
+        ``handle.stream(..., _method="generate")``): yields token ids as
+        they decode. Request fields: prompt (required), max_new_tokens,
+        temperature, priority, eos_token, request_id, seed."""
+        r = self._parse(request)
+        yield from self.engine.generate(
+            r["prompt"],
+            max_new_tokens=r.get("max_new_tokens"),
+            temperature=float(r.get("temperature", 0.0)),
+            priority=int(r.get("priority", 0)),
+            eos_token=r.get("eos_token"),
+            request_id=r.get("request_id"),
+            seed=r.get("seed"),
+        )
+
+    def __call__(self, request) -> Dict[str, Any]:
+        """Non-streaming: returns the full generation in one reply."""
+        return {"tokens": list(self.generate(request))}
+
+    # -- introspection ----------------------------------------------------
+    def engine_stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    def metrics_address(self) -> Optional[str]:
+        if self._metrics_server is None:
+            return None
+        return f"{self._metrics_server.host}:{self._metrics_server.port}"
+
+    def begin_drain(self, grace_s: Optional[float] = None) -> None:
+        """Test/ops hook: drain without a node event."""
+        self.engine.begin_drain(grace_s)
+
+    def check_health(self) -> bool:
+        return not self.engine._stop.is_set()
+
+    def __del__(self):
+        try:
+            self.engine.stop()
+            if self._metrics_server is not None:
+                self._metrics_server.stop()
+        except Exception:
+            pass
+
+
+def llm_deployment(
+    model_cfg=None,
+    *,
+    engine: Any = None,
+    name: str = "llm",
+    num_replicas: int = 1,
+    max_concurrent_queries: int = 32,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+    route_prefix: Optional[str] = "/llm",
+    seed: int = 0,
+    autoscaling_config=None,
+):
+    """Build a Serve deployment serving ``model_cfg`` through a
+    continuous-batching engine (the ``serve.llm`` entry point).
+
+    ``serve.run(llm_deployment(cfg).bind())`` → DeploymentHandle whose
+    ``stream(request, _method="generate")`` yields tokens and whose
+    ``remote(request)`` returns the whole generation.
+    """
+    from ray_tpu import serve
+
+    dep = serve.deployment(
+        name=name,
+        num_replicas=num_replicas,
+        max_concurrent_queries=max_concurrent_queries,
+        ray_actor_options=ray_actor_options,
+        route_prefix=route_prefix,
+        autoscaling_config=autoscaling_config,
+    )(LLMServer)
+
+    class _BoundDeployment:
+        """Deployment with the model/engine config pre-bound."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def bind(self, **overrides):
+            kwargs = {"seed": seed, **overrides}
+            return self._inner.bind(model_cfg, engine, **kwargs)
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+    return _BoundDeployment(dep)

@@ -344,6 +344,172 @@ def init_sharded(cfg: LlamaConfig, mesh, rules, rng, optimizer=None):
     return params, opt_state
 
 
+# ---------------------------------------------------------------------------
+# paged-KV autoregressive decode (inference engine path)
+#
+# Layout (vLLM-style, GQA-aware): one K and one V tensor of shape
+#   [n_layers, num_blocks, block_size, n_kv_heads, head_dim]
+# shared by every request. A request owns a list of block ids (its block
+# table row); token position p lives at (blocks[p // block_size],
+# p % block_size) in EVERY layer — block ids are layer-agnostic so the
+# host-side allocator hands out one id per block_size tokens, not one per
+# layer. K/V stay at n_kv_heads (GQA kept compressed in HBM, exactly as
+# the flash kernel does): queries are grouped [n_kv, rep] at score time,
+# so cache traffic is 1/rep of the repeated layout.
+#
+# Block id 0 is the NULL block: never allocated, padding positions write
+# into it and masked reads from it never reach the softmax. Keeping the
+# trash in-band is what lets every step run with fully static shapes.
+
+
+def init_paged_kv_cache(
+    cfg: LlamaConfig, num_blocks: int, block_size: int, dtype=None
+) -> Dict[str, jax.Array]:
+    """Device-side paged KV cache (zeros; block 0 reserved as null)."""
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    dt = dtype or cfg.dtype
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _rope_at(cfg: LlamaConfig, positions):
+    """cos/sin tables at arbitrary int positions: [N] -> ([N, hd/2] x2)."""
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, jnp.float32) / hd))
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _apply_rope_flat(x, cos, sin):
+    """x: [N, H, hd] with per-row position tables [N, hd/2]."""
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    out1 = x1 * c - x2 * s
+    out2 = x2 * c + x1 * s
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _scatter_kv(cache, layer: int, blk, off, k, v):
+    """Write per-token K/V into their cache slots. blk/off: [N] int32,
+    k/v: [N, n_kv, hd]. Padding rows target the null block — colliding
+    trash writes are fine, nothing masked-in ever reads them."""
+    return {
+        "k": cache["k"].at[layer, blk, off].set(k),
+        "v": cache["v"].at[layer, blk, off].set(v),
+    }
+
+
+def paged_prefill_step(
+    cfg: LlamaConfig, params, cache, tokens, block_table, ctx_len, true_len
+):
+    """One prefill chunk for ONE request, fixed shapes.
+
+    tokens: [C] int32 (right-padded chunk), block_table: [M] int32 (padded
+    with 0 = null), ctx_len: scalar int32 tokens ALREADY cached (chunked
+    prefill: >0 from the second chunk on), true_len: scalar int32 valid
+    tokens in this chunk. Writes the chunk's K/V into the cache, attends
+    causally over cached-context + chunk, and returns
+    ``(cache, logits[vocab])`` for the chunk's last valid token.
+    """
+    if cfg.moe_experts > 0:
+        raise NotImplementedError("paged decode does not support MoE FFNs yet")
+    C = tokens.shape[0]
+    M = block_table.shape[0]
+    bs = cache["k"].shape[2]
+    rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    idx = jnp.arange(C, dtype=jnp.int32)
+    pos = ctx_len + idx  # global positions of the chunk's tokens
+    valid = idx < true_len
+    blk = jnp.where(valid, block_table[jnp.minimum(pos // bs, M - 1)], 0)
+    off = pos % bs
+    cos, sin = _rope_at(cfg, pos)
+    # key j (global position) visible to chunk query i iff j <= ctx_len+i
+    key_pos = jnp.arange(M * bs, dtype=jnp.int32)
+    mask = key_pos[None, :] <= pos[:, None]  # [C, M*bs]
+
+    x = params["embed"][tokens]  # [C, D]
+    for layer, p in enumerate(params["layers"]):
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("cd,dhk->chk", h, p["wq"])
+        k = jnp.einsum("cd,dhk->chk", h, p["wk"])
+        v = jnp.einsum("cd,dhk->chk", h, p["wv"])
+        q = _apply_rope_flat(q, cos, sin)
+        k = _apply_rope_flat(k, cos, sin)
+        cache = _scatter_kv(cache, layer, blk, off, k, v)
+        # gather AFTER the scatter so the chunk attends to itself
+        ks = cache["k"][layer, block_table].reshape(M * bs, cfg.n_kv_heads, -1)
+        vs = cache["v"][layer, block_table].reshape(M * bs, cfg.n_kv_heads, -1)
+        qg = q.reshape(C, cfg.n_kv_heads, rep, -1)
+        s = jnp.einsum("cgrh,sgh->cgrs", qg, ks).astype(jnp.float32) * scale
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        pattn = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("cgrs,sgh->cgrh", pattn.astype(vs.dtype), vs)
+        o = o.reshape(C, cfg.n_heads, -1)
+        x = x + jnp.einsum("chk,hkd->cd", o.astype(x.dtype), p["wo"])
+        hm = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        gate = jnp.einsum("cd,dm->cm", hm, p["w_gate"])
+        up = jnp.einsum("cd,dm->cm", hm, p["w_up"])
+        x = x + jnp.einsum("cm,md->cd", jax.nn.silu(gate) * up, p["w_down"])
+    last = jnp.maximum(true_len - 1, 0)
+    h_last = rms_norm(x[last], params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("d,dv->v", h_last, params["lm_head"]).astype(jnp.float32)
+    return cache, logits
+
+
+def paged_decode_step(
+    cfg: LlamaConfig, params, cache, tokens, positions, block_tables, ctx_lens
+):
+    """One decode step for a BATCH of slots, fixed shapes.
+
+    tokens: [B] int32 (this step's input token per slot), positions: [B]
+    int32 (its global position), block_tables: [B, M] int32, ctx_lens: [B]
+    int32 (visible context length INCLUDING this token = positions+1 for
+    active slots; inactive padding slots carry ctx_len=1 and null blocks
+    so the softmax stays finite). Writes K/V, returns
+    ``(cache, logits [B, vocab])``.
+    """
+    if cfg.moe_experts > 0:
+        raise NotImplementedError("paged decode does not support MoE FFNs yet")
+    B, M = block_tables.shape
+    bs = cache["k"].shape[2]
+    rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    brange = jnp.arange(B, dtype=jnp.int32)
+    blk = block_tables[brange, jnp.minimum(positions // bs, M - 1)]
+    off = positions % bs
+    cos, sin = _rope_at(cfg, positions)
+    key_pos = jnp.arange(M * bs, dtype=jnp.int32)
+    mask = key_pos[None, :] < ctx_lens[:, None]  # [B, M*bs]
+
+    x = params["embed"][tokens]  # [B, D]
+    for layer, p in enumerate(params["layers"]):
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bd,dhk->bhk", h, p["wq"])
+        k = jnp.einsum("bd,dhk->bhk", h, p["wk"])
+        v = jnp.einsum("bd,dhk->bhk", h, p["wv"])
+        q = _apply_rope_flat(q, cos, sin)
+        k = _apply_rope_flat(k, cos, sin)
+        cache = _scatter_kv(cache, layer, blk, off, k, v)
+        ks = cache["k"][layer, block_tables].reshape(B, M * bs, cfg.n_kv_heads, -1)
+        vs = cache["v"][layer, block_tables].reshape(B, M * bs, cfg.n_kv_heads, -1)
+        qg = q.reshape(B, cfg.n_kv_heads, rep, -1)
+        s = jnp.einsum("bgrh,bsgh->bgrs", qg, ks).astype(jnp.float32) * scale
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        pattn = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bgrs,bsgh->bgrh", pattn.astype(vs.dtype), vs)
+        o = o.reshape(B, cfg.n_heads, -1)
+        x = x + jnp.einsum("bhk,hkd->bd", o.astype(x.dtype), p["wo"])
+        hm = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        gate = jnp.einsum("bd,dm->bm", hm, p["w_gate"])
+        up = jnp.einsum("bd,dm->bm", hm, p["w_up"])
+        x = x + jnp.einsum("bm,md->bd", jax.nn.silu(gate) * up, p["w_down"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return cache, jnp.einsum("bd,dv->bv", x, params["lm_head"]).astype(jnp.float32)
+
+
 def make_train_step(cfg: LlamaConfig, optimizer, *, remat: bool = False, donate: bool = True, mesh=None):
     """Returns jitted ``step((params, opt_state), batch) → (state, loss)``.
 

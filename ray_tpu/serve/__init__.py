@@ -124,11 +124,15 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         return self._router.dispatch("__call__", args, kwargs, self._model_id)
 
-    def call(self, *args, _timeout: Optional[float] = 60.0, **kwargs):
+    def call(self, *args, _timeout: Optional[float] = 60.0, _idempotent: bool = True, **kwargs):
         """Blocking retry-until-executed call (survives replica death
-        mid-rolling-update)."""
+        mid-rolling-update). AT-LEAST-ONCE by default — see
+        ``Router.execute`` for the retry contract; pass
+        ``_idempotent=False`` for non-idempotent requests so a
+        post-dispatch replica death propagates instead of re-executing."""
         return self._router.execute(
-            "__call__", args, kwargs, model_id=self._model_id, timeout=_timeout
+            "__call__", args, kwargs, model_id=self._model_id,
+            timeout=_timeout, idempotent=_idempotent,
         )
 
     def stream(self, *args, _method: str = "__call__", _timeout: Optional[float] = 60.0, **kwargs):
@@ -193,6 +197,20 @@ def shutdown() -> None:
         pass
 
 
+def __getattr__(name: str):
+    # lazy: the LLM deployment pulls in jax via the inference engine —
+    # plain serve users (and control-plane processes) must not pay that
+    if name == "llm_deployment":
+        from ray_tpu.inference.serve_llm import llm_deployment
+
+        return llm_deployment
+    if name == "LLMServer":
+        from ray_tpu.inference.serve_llm import LLMServer
+
+        return LLMServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "Application",
     "AutoscalingConfig",
@@ -204,6 +222,9 @@ __all__ = [
     "deployment",
     "get_deployment_handle",
     "get_multiplexed_model_id",
+    # llm_deployment/LLMServer stay OUT of __all__: star-imports resolve
+    # every listed name, which would trigger the lazy __getattr__ above
+    # and drag jax into plain serve users. Reach them by attribute.
     "multiplexed",
     "run",
     "shutdown",

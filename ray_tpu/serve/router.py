@@ -198,10 +198,25 @@ class Router:
         *,
         model_id: str = "",
         timeout: Optional[float] = 60.0,
+        idempotent: bool = True,
     ):
         """Retry-until-executed (reference router semantics): a dispatch
         that lands on a dying replica re-chooses. App-level exceptions
         are NOT retried — only replica death/crash.
+
+        RETRY CONTRACT — this path is AT-LEAST-ONCE. The runtime cannot
+        tell "replica died before it saw the push" apart from "replica
+        executed (part of) the request, then died": both surface as
+        ActorDiedError from the result get. With ``idempotent=True``
+        (default) the router re-executes on a survivor either way, so a
+        non-idempotent request (LLM generation, a payment, an append) can
+        run twice after an unlucky crash. Pass ``idempotent=False`` to
+        auto-retry only when the push provably never reached a replica
+        (submission-side failure); a post-dispatch death then propagates
+        to the caller, who owns the dedupe/retry decision (e.g. resubmit
+        with the same request_id). Streaming callers get the tighter
+        contract for free: ``execute_stream`` only ever replays before
+        the first item.
 
         One Deadline covers the whole call (core/deadline.py): dispatch
         retries AND the result get draw from the same budget, clamped by
@@ -212,15 +227,26 @@ class Router:
         while not deadline.expired:
             replica = self.choose_replica(model_id)
             self._bump(replica)
-            ref = replica.handle_request.remote(
-                method, list(args), dict(kwargs or {}), model_id
-            )
+            try:
+                ref = replica.handle_request.remote(
+                    method, list(args), dict(kwargs or {}), model_id
+                )
+            except (ActorDiedError, WorkerCrashedError) as e:
+                # submission failed: the request never reached a replica,
+                # safe to re-choose even for non-idempotent work
+                last_err = e
+                self._drop_replica(replica)
+                continue
             try:
                 remaining = max(1.0, deadline.remaining())
                 return ray_tpu.get(ref, timeout=remaining)
             except (ActorDiedError, WorkerCrashedError) as e:
                 last_err = e
                 self._drop_replica(replica)
+                if not idempotent:
+                    # the push may have been delivered and executed —
+                    # replaying could duplicate a side effect
+                    raise
                 continue
         raise last_err or TimeoutError(
             f"no replica executed {self._deployment}.{method} in time"

@@ -130,6 +130,10 @@ def generate_variants(
 # Search algorithms (reference tune/search/searcher.py + adapters)
 
 
+_MISSING = object()
+_restored_seq = itertools.count()
+
+
 class Searcher:
     """Sequential search-algorithm ABC (reference ``Searcher``): the
     Tuner asks ``suggest`` for each new trial's config and feeds final
@@ -145,6 +149,20 @@ class Searcher:
 
     def on_trial_complete(self, trial_id: str, result: Dict[str, Any]) -> None:
         pass
+
+    def add_evaluated_point(self, config: Dict[str, Any], result: Dict[str, Any]) -> None:
+        """Feed an ALREADY-EVALUATED (config, result) pair into the
+        searcher's model — the resume/warm-start path (reference
+        ``Searcher.add_evaluated_point``). Unlike ``on_trial_complete``
+        this takes the config itself, not a trial id: restored trials
+        were never ``suggest``-ed in this process, so id-keyed completion
+        is a silent no-op for model-based searchers (TPE/Optuna override
+        this). Default: replay through ``on_trial_complete`` with a
+        synthetic id so subclasses that key their model off the result
+        alone still warm-start; for id-keyed subclasses that don't
+        override, the unknown id makes this a no-op — identical to the
+        pre-``add_evaluated_point`` resume behavior, never worse."""
+        self.on_trial_complete(f"__restored_{next(_restored_seq)}", result)
 
 
 class RandomSearch(Searcher):
@@ -325,6 +343,30 @@ class TPESearcher(Searcher):
             v = -v
         self._history.append((flat, v))
 
+    def add_evaluated_point(self, config: Dict[str, Any], result: Dict[str, Any]) -> None:
+        """Warm-start from a (config, result) pair that was evaluated
+        elsewhere (a restored trial): flatten the config along this
+        space's sampled dims and append straight to the TPE history —
+        equivalent to suggest + on_trial_complete without a live entry."""
+        if not result or self.metric not in result:
+            return
+        flat: Dict[Tuple[str, ...], Any] = {}
+        for path, _leaf in self._flat_sample_dims():
+            node: Any = config
+            for part in path:
+                if not isinstance(node, dict) or part not in node:
+                    node = _MISSING
+                    break
+                node = node[part]
+            if node is not _MISSING:
+                flat[path] = node
+        if not flat:
+            return
+        v = float(result[self.metric])
+        if self.mode == "min":
+            v = -v
+        self._history.append((flat, v))
+
 
 class ConcurrencyLimiter(Searcher):
     """Caps in-flight suggestions (reference
@@ -349,6 +391,9 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id: str, result: Dict[str, Any]) -> None:
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result)
+
+    def add_evaluated_point(self, config: Dict[str, Any], result: Dict[str, Any]) -> None:
+        self.searcher.add_evaluated_point(config, result)
 
 
 class OptunaSearch(Searcher):
@@ -408,3 +453,56 @@ class OptunaSearch(Searcher):
         if ot is None or self.metric not in result:
             return
         self._study.tell(ot, float(result[self.metric]))
+
+    def add_evaluated_point(self, config: Dict[str, Any], result: Dict[str, Any]) -> None:
+        """Warm-start the study with a finished trial: rebuild the
+        param/distribution maps from the search space and
+        ``study.add_trial`` a COMPLETE optuna trial (the documented
+        optuna resume recipe). Dims the study can't express (opaque
+        samplers, callables) are skipped."""
+        if not result or self.metric not in result or self._study is None:
+            return
+        optuna = self._optuna
+        params: Dict[str, Any] = {}
+        dists: Dict[str, Any] = {}
+        for path, leaf in _walk(self.param_space):
+            name = ".".join(path)
+            node: Any = config
+            for part in path:
+                if not isinstance(node, dict) or part not in node:
+                    node = _MISSING  # None is a legitimate sampled value
+                    break
+                node = node[part]
+            if node is _MISSING:
+                continue
+            if isinstance(leaf, _Grid):
+                dists[name] = optuna.distributions.CategoricalDistribution(leaf.values)
+            elif isinstance(leaf, _Sampler) and leaf.kind in ("uniform", "loguniform"):
+                dists[name] = optuna.distributions.FloatDistribution(
+                    leaf.low, leaf.high, log=leaf.kind == "loguniform"
+                )
+            elif isinstance(leaf, _Sampler) and leaf.kind == "randint":
+                q = getattr(leaf, "q", 1) or 1
+                lo = int(leaf.low)
+                hi = lo + ((int(leaf.high) - 1 - lo) // q) * q
+                dists[name] = optuna.distributions.IntDistribution(lo, hi, step=q)
+            elif isinstance(leaf, _Sampler) and leaf.kind == "choice" and leaf.options:
+                dists[name] = optuna.distributions.CategoricalDistribution(
+                    list(leaf.options)
+                )
+            else:
+                continue
+            params[name] = node
+        if not params:
+            return
+        try:
+            self._study.add_trial(
+                optuna.trial.create_trial(
+                    params=params,
+                    distributions=dists,
+                    value=float(result[self.metric]),
+                )
+            )
+        except Exception:
+            # a malformed restored config must not kill the resume
+            pass

@@ -290,10 +290,14 @@ class Tuner:
             else:
                 # resume: replay completed trials into the searcher so
                 # its model warm-starts, then suggest the REMAINING
-                # budget (not zero — that would silently truncate)
+                # budget (not zero — that would silently truncate).
+                # add_evaluated_point, NOT on_trial_complete: restored
+                # trial ids were never suggest()-ed in this process, so
+                # id-keyed completion is a silent no-op for TPE/Optuna
+                # (their live-trial maps are empty after a restart).
                 for t in trials:
                     if t.status in (TERMINATED, STOPPED, ERRORED) and t.last_metrics:
-                        search.on_trial_complete(t.trial_id, t.last_metrics)
+                        search.add_evaluated_point(t.config, t.last_metrics)
                 to_suggest = max(0, cfg.num_samples - len(trials))
         trials_by_id = {t.trial_id: t for t in trials}
         launching: List[tuple] = []  # (trial, run_ref): actor may be queued

@@ -61,13 +61,40 @@ from ray_tpu.util.reaper import find_runtime_pids, pid_alive, reap_all  # noqa: 
 # processes don't import this conftest and keep the 120s hard abort.
 _event_stats.ABORT_DISABLED_IN_PROCESS = True
 
-# faulthandler output must reach the REAL terminal even when pytest's
-# fd-level capture is active at dump time — keep a dup of stderr from
-# import time (capture is not yet installed for initial conftests)
+# faulthandler output must survive pytest's fd-level capture. A dup of
+# fd 2 here does NOT work: tests/conftest.py imports during collection,
+# AFTER the capture plugin has already swapped fd 2 for its tempfile, so
+# the dup points into the capture buffer and _exit(1) discards it — the
+# hard-timeout abort then looks like a silent exit-code-1 with zero
+# output (exactly the unattributable wedge this timer exists to avoid).
+# Dump to a well-known file instead; truncated each session, announced in
+# pytest's report header (the one place guaranteed visible in the log even
+# when the abort itself prints nothing), overridable for parallel runs.
+_DUMP_PATH = os.environ.get(
+    "RAY_TPU_TEST_DUMP_FILE", "/tmp/raytpu_test_timeout_dump.log"
+)
 try:
-    _REAL_STDERR = os.fdopen(os.dup(2), "w")
+    _DUMP_FILE = open(_DUMP_PATH, "w")
+    _DUMP_FILE.write(
+        "armed: a per-test hard-timeout stack dump will land here "
+        "(tests/conftest.py raytpu_test_timeout); an empty-but-armed file "
+        "means no test overran its timer\n"
+    )
+    _DUMP_FILE.flush()
 except OSError:
-    _REAL_STDERR = None
+    _DUMP_FILE = None
+
+
+def pytest_report_header(config):
+    # a hard-timeout abort is exit-code-1 with ZERO terminal output (fd 2
+    # is pytest's capture tempfile by dump time) — this header line is how
+    # an operator staring at a silent crash finds the stacks
+    if _DUMP_FILE is None:
+        return "hard-timeout stack dumps: DISABLED (could not open dump file)"
+    return (
+        f"hard-timeout stack dumps land in {_DUMP_PATH} "
+        "(silent exit-1 run? look there; last '[armed]' line names the test)"
+    )
 
 
 @pytest.fixture
@@ -131,8 +158,13 @@ def pytest_runtest_protocol(item, nextitem):
     if armed:
         # exit=True: a test that outlives the timer is unrecoverably wedged
         # (futex/GIL/asyncio) — dump all stacks and kill the process so the
-        # outer harness sees a crash named by these stacks, not a freeze
-        kwargs = {"file": _REAL_STDERR} if _REAL_STDERR is not None else {}
+        # outer harness sees a crash named by these stacks, not a freeze.
+        # The dump goes to _DUMP_FILE (see above); record WHICH test armed
+        # the timer so the abort is attributable even mid-dump.
+        if _DUMP_FILE is not None:
+            _DUMP_FILE.write(f"[armed] {item.nodeid}\n")
+            _DUMP_FILE.flush()
+        kwargs = {"file": _DUMP_FILE} if _DUMP_FILE is not None else {}
         faulthandler.dump_traceback_later(timeout, exit=True, **kwargs)
     try:
         yield
