@@ -146,6 +146,37 @@ def spawn_node(
     return proc
 
 
+def spawn_controller(
+    session_dir: str, port: int = 0
+) -> subprocess.Popen:
+    """Spawn a STANDALONE controller process (``controller_main.py``) —
+    the failover topology where the control plane can be killed and
+    restarted from its snapshot independently of every node daemon.
+    Restarting with the same ``session_dir`` restores state AND the old
+    listening port, so clients reconnect with no rediscovery. The
+    returned proc carries ``controller_port``."""
+    from ray_tpu.core.config import serialize_config
+
+    os.makedirs(session_dir, exist_ok=True)
+    cmd = [
+        sys.executable, "-m", "ray_tpu.core.controller_main",
+        "--session-dir", session_dir, "--port", str(port),
+        "--system-config", serialize_config(),
+    ]
+    err_f = open(os.path.join(session_dir, "controller.log"), "ab")
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=err_f, start_new_session=True,
+        env=_subprocess_env(),
+    )
+    line = proc.stdout.readline().decode()
+    if not line:
+        raise RuntimeError(
+            f"controller failed to start (see {session_dir}/controller.log)"
+        )
+    proc.controller_port = json.loads(line)["controller_port"]  # type: ignore[attr-defined]
+    return proc
+
+
 def _stop(proc: subprocess.Popen) -> None:
     """Escalating stop of a spawned runtime process AND its process group
     (head/node daemons run with start_new_session=True and own their

@@ -185,6 +185,17 @@ class GlobalConfig:
     rpc_retry_base_delay_s: float = 0.05
     rpc_retry_max_delay_s: float = 2.0
     rpc_max_retries: int = 5
+    # --- exactly-once request dedup (core/rpc.py) ---
+    #: stamp mutating RPCs with (client id, request id) and answer
+    #: retried duplicates from a server-side reply cache instead of
+    #: re-executing the handler (the lost-reply trap). Idempotent
+    #: methods (rpc.IDEMPOTENT_METHODS) skip the cache entirely.
+    rpc_dedup_enabled: bool = True
+    #: reply-cache bounds per server process; oldest-first eviction. A
+    #: retry arriving after its entry was evicted re-executes — size the
+    #: window well past (retry budget × max backoff) worth of traffic.
+    rpc_dedup_cache_entries: int = 4096
+    rpc_dedup_cache_max_bytes: int = 32 * 1024**2
 
     # --- task events / observability ---
     task_events_buffer_size: int = 10000
@@ -192,7 +203,15 @@ class GlobalConfig:
     metrics_report_period_s: float = 2.0
 
     # --- testing / chaos ---
-    testing_rpc_failure: str = ""  # "method:failure_prob" fault injection
+    testing_rpc_failure: str = ""  # legacy "method:failure_prob" (pre-handler)
+    #: seeded per-method fault plan: "method:mode:prob[:param],..." with
+    #: mode in {request_drop, reply_drop, delay, disconnect} — see
+    #: util/chaos.py::RpcFaultPlan for the grammar and determinism
+    #: contract. Empty = no injection.
+    testing_rpc_chaos: str = ""
+    #: RNG seed for the fault plan; 0 = generate one (printed at
+    #: activation so any failure reproduces from the log)
+    testing_rpc_chaos_seed: int = 0
 
     def reset(self) -> None:
         for f in fields(self):

@@ -134,9 +134,23 @@ class Controller:
         try:
             port = await self.server.start()
         except OSError:
-            # old port still held (e.g. lingering socket): fall back
-            self.server.port = 0
-            port = await self.server.start()
+            # Old port still held — usually the predecessor's socket not
+            # yet released after a SIGKILL. The old port is the ONLY
+            # address daemons and drivers know, so spend a short patience
+            # window retrying before falling back to a fresh port (which
+            # strands every existing client on the dead address).
+            port = None
+            if restored_port and self.server.port == restored_port:
+                for _ in range(50):
+                    await asyncio.sleep(0.1)
+                    try:
+                        port = await self.server.start()
+                        break
+                    except OSError:
+                        continue
+            if port is None:
+                self.server.port = 0
+                port = await self.server.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self.persist_path:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
@@ -391,6 +405,11 @@ class Controller:
             labels=payload.get("labels", {}),
         )
         self.nodes[info.node_id] = info
+        stale = self.node_clients.pop(info.node_id, None)
+        if stale is not None:
+            # re-registration (e.g. a dedup-window miss replaying after a
+            # chaos'd reply): don't leak the old client's read task
+            asyncio.ensure_future(stale.close())
         self.node_clients[info.node_id] = RpcClient(info.host, info.port, name="noded")
         # Re-adoption: a (re)registering daemon reports the PG bundles it
         # still holds; a restarted controller reattaches them to RESTORING
@@ -666,6 +685,7 @@ class Controller:
                         f"namespace {spec.namespace!r}"
                     )
             self.named_actors[key] = spec.actor_id
+        self._mark_dirty()
         asyncio.ensure_future(self._schedule_actor(spec.actor_id))
         return {"ok": True}
 
@@ -756,6 +776,7 @@ class Controller:
         ) and not self._stopping:
             if not budget_free:
                 info.num_restarts += 1
+                self._mark_dirty()
             info.state = "RESTARTING"
             info.address = None
             await self._publish(
@@ -776,6 +797,7 @@ class Controller:
             return
         info.state = "DEAD"
         info.death_reason = reason
+        self._mark_dirty()  # DEAD actors leave the snapshot
         await self._publish(
             ACTOR_PUSH_CHANNEL,
             {"actor_id": actor_id, "state": "DEAD", "reason": reason, "error": creation_error},
@@ -853,6 +875,7 @@ class Controller:
         self.pgs[pg_id] = info
         if info.name:
             self.named_pgs[info.name] = pg_id
+        self._mark_dirty()
         asyncio.ensure_future(self._schedule_pg(pg_id))
         return {"ok": True}
 
@@ -955,6 +978,7 @@ class Controller:
         info.state = "REMOVED"
         if info.name:
             self.named_pgs.pop(info.name, None)
+        self._mark_dirty()
         # Drop the table entry: long-lived clusters cycle many PGs and the
         # table would otherwise grow without bound. A bounded tombstone
         # lets racing clients tell "removed" apart from "never existed".
@@ -1080,13 +1104,17 @@ class Controller:
     # ---- kv ------------------------------------------------------------
     async def c_kv_put(self, payload, conn):
         self.kv[payload["key"]] = payload["value"]
+        self._mark_dirty()
         return True
 
     async def c_kv_get(self, payload, conn):
         return self.kv.get(payload["key"])
 
     async def c_kv_del(self, payload, conn):
-        return self.kv.pop(payload["key"], None) is not None
+        existed = self.kv.pop(payload["key"], None) is not None
+        if existed:
+            self._mark_dirty()
+        return existed
 
     async def c_kv_keys(self, payload, conn):
         prefix = payload.get("prefix", b"")
@@ -1095,6 +1123,7 @@ class Controller:
     # ---- jobs ----------------------------------------------------------
     async def c_register_job(self, payload, conn):
         self.jobs[payload["job_id"]] = {"start_time": time.time(), **payload}
+        self._mark_dirty()
         return True
 
     async def c_ping(self, payload, conn):

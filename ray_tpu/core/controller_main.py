@@ -1,0 +1,76 @@
+"""Standalone controller process (control-plane failover topology).
+
+Reference: ``gcs_server_main.cc`` — the GCS runs as its own process so
+it can be killed and restarted independently of any raylet. The default
+local topology co-hosts controller + head daemon in one process
+(``head_main.py``); THIS entrypoint exists for deployments (and the
+controller-failover tests) where the control plane must be able to die
+and come back from its snapshot while every node daemon, worker, and
+driver stays up and reconnects.
+
+On restart with the same ``--session-dir``, ``Controller._load_snapshot``
+restores the KV / job / PG / actor tables AND the old listening port, so
+existing clients reconnect to the same address with no rediscovery;
+daemons re-register (carrying held bundles and running actors for
+re-adoption) the moment their next resource sync returns
+``unknown_node``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+
+
+async def amain(args) -> None:
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.core.controller import Controller
+
+    if args.system_config:
+        GLOBAL_CONFIG.apply_system_config(json.loads(args.system_config))
+    persist = None
+    if args.session_dir:
+        os.makedirs(args.session_dir, exist_ok=True)
+        persist = os.path.join(args.session_dir, "controller_snapshot.pkl")
+    controller = Controller(port=args.port, persist_path=persist)
+    cport = await controller.start()
+    print(json.dumps({"controller_port": cport}), flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    # driver-owned controllers die with their driver (hang defense);
+    # detached CLI deployments never set the env var and survive
+    from ray_tpu.util.reaper import start_orphan_watch
+
+    start_orphan_watch(lambda: loop.call_soon_threadsafe(stop.set))
+    await stop.wait()
+    await controller.stop()
+
+
+def main() -> None:
+    import faulthandler
+
+    faulthandler.enable()
+    faulthandler.register(signal.SIGUSR2, all_threads=True)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session-dir", type=str, default=None)
+    parser.add_argument("--system-config", type=str, default="")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    main()

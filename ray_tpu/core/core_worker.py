@@ -179,7 +179,13 @@ class CoreWorker(RuntimeBackend):
             for name in [m for m in dir(self) if m.startswith("w_")]:
                 self.server.register(name[2:], getattr(self, name))
             port = await self.server.start()
-            self.controller = RpcClient(controller_host, controller_port, name="controller")
+            # retry-by-default toward the control plane: mutating calls
+            # are dedup-stamped (core/rpc.py), so a controller restart or
+            # a lost reply is a transparent retry, never a duplicate
+            self.controller = RpcClient(
+                controller_host, controller_port, name="controller",
+                default_retries=GLOBAL_CONFIG.rpc_max_retries,
+            )
             self.daemon = RpcClient(daemon_host, daemon_port, name="noded")
             self.controller.subscribe_push(ACTOR_PUSH_CHANNEL, self._on_actor_push)
             self.controller.subscribe_push(PG_PUSH_CHANNEL, self._on_pg_push)
@@ -192,6 +198,11 @@ class CoreWorker(RuntimeBackend):
                 # waste pushes on processes that would drop them
                 self.controller.subscribe_push(LOG_PUSH_CHANNEL, self._on_log_push)
                 channels.append(LOG_PUSH_CHANNEL)
+            # push subscriptions are per-connection server-side: a
+            # controller restart silently drops them, so re-subscribe on
+            # every reconnect (reconnect-and-reconcile)
+            self._push_channels = channels
+            self.controller.on_reconnect = self._on_controller_reconnect
             await self.controller.call(
                 "subscribe",
                 {"channels": channels},
@@ -201,6 +212,23 @@ class CoreWorker(RuntimeBackend):
 
         self.port = self.io.run(_setup())
         self.host = "127.0.0.1"
+
+    async def _on_controller_reconnect(self) -> None:
+        """The controller connection was re-established (restart or
+        transient reset): re-subscribe push channels — server-side
+        subscription state died with the old connection."""
+        from ray_tpu.observability.rpc_metrics import CONTROLLER_RECONNECTS
+
+        CONTROLLER_RECONNECTS.inc(
+            labels={"role": "worker" if self.executor is not None else "driver"}
+        )
+        if self._stopping:
+            return
+        await self.controller.call(
+            "subscribe",
+            {"channels": self._push_channels},
+            retries=GLOBAL_CONFIG.rpc_max_retries,
+        )
 
     def finish_init(self, node_id: bytes) -> None:
         self.node_id = node_id
@@ -1425,6 +1453,14 @@ class CoreWorker(RuntimeBackend):
         with self._actors_lock:
             st = self._actors.setdefault(actor_id, _ActorState())
         retries_left = {s.task_id.binary(): st.max_task_retries for s in batch}
+        # Request-id reuse (exactly-once): every re-push of THIS batch to
+        # the SAME replica/client shares one dedup slot, so a push whose
+        # reply was lost after execution is answered from the server's
+        # reply cache instead of running twice. A new client (actor moved)
+        # or a trimmed batch gets a fresh id — different logical request.
+        push_client = None
+        push_rid: Optional[int] = None
+        transport_retries = 0
         try:
             while batch:
                 try:
@@ -1440,6 +1476,10 @@ class CoreWorker(RuntimeBackend):
                         )
                     return
                 client = self._client(st.address.host, st.address.port)
+                if client is not push_client:
+                    push_client = client
+                    push_rid = client.next_request_id()
+                    transport_retries = 0
                 for s in batch:
                     # streaming methods need the producer's address for
                     # consumer-position (backpressure) reports
@@ -1454,10 +1494,13 @@ class CoreWorker(RuntimeBackend):
                         {"specs": [encode_spec(s) for s in batch]},
                         timeout=None,
                         connect_timeout=3.0,
+                        request_id=push_rid,
                     )
                 except ChaosInjectedError:
-                    # pre-execution injection: retry the batch, actor is
-                    # fine and no task retry budget is consumed
+                    # injected fault: retry the batch under the SAME
+                    # request id — if the handler already ran (reply
+                    # dropped), the dedup cache answers; no task retry
+                    # budget is consumed either way
                     await asyncio.sleep(0.02)
                     continue
                 except ConnectionLost:
@@ -1477,6 +1520,26 @@ class CoreWorker(RuntimeBackend):
                             st.reason = info.get("reason", "")
                         else:
                             st.state = "DEAD"
+                    if (
+                        st.state == "ALIVE"
+                        and st.address is not None
+                        and (st.address.host, st.address.port)
+                        == (client.host, client.port)
+                        and transport_retries < GLOBAL_CONFIG.rpc_max_retries
+                    ):
+                        # same live incarnation, connection blip only: the
+                        # re-push is dedup-protected (same request id) —
+                        # retry transparently, consuming NO task retry
+                        # budget and without trimming streaming calls.
+                        # This is what makes non-idempotent serve calls
+                        # safely auto-retryable while the replica is
+                        # reachable (serve/router.py contract).
+                        transport_retries += 1
+                        await asyncio.sleep(0.1)
+                        continue
+                    # actor moved/died (or retries exhausted): the next
+                    # push is a DIFFERENT logical request — fresh id
+                    push_client = None
                     survivors: List[TaskSpec] = []
                     for s in batch:
                         tid = s.task_id.binary()
@@ -1526,6 +1589,11 @@ class CoreWorker(RuntimeBackend):
             with self._actors_lock:
                 st = self._actors.setdefault(spec.actor_id, _ActorState())
             retries_left = st.max_task_retries
+            # request-id reuse across re-pushes to the same incarnation
+            # (see _submit_actor_batch for the exactly-once rationale)
+            push_client = None
+            push_rid: Optional[int] = None
+            transport_retries = 0
             while True:
                 st = await self._resolve_actor(spec.actor_id)
                 if st.state == "DEAD":
@@ -1534,6 +1602,10 @@ class CoreWorker(RuntimeBackend):
                     )
                     return
                 client = self._client(st.address.host, st.address.port)
+                if client is not push_client:
+                    push_client = client
+                    push_rid = client.next_request_id()
+                    transport_retries = 0
                 if spec.num_returns == "streaming":
                     self._inflight_workers[spec.task_id.binary()] = (
                         st.address.host,
@@ -1545,6 +1617,7 @@ class CoreWorker(RuntimeBackend):
                         {"spec": encode_spec(spec)},
                         timeout=None,
                         connect_timeout=3.0,
+                        request_id=push_rid,
                     )
                 except ChaosInjectedError:
                     await asyncio.sleep(0.02)
@@ -1559,6 +1632,19 @@ class CoreWorker(RuntimeBackend):
                             st.reason = info.get("reason", "")
                         else:
                             st.state = "DEAD"
+                    if (
+                        st.state == "ALIVE"
+                        and st.address is not None
+                        and (st.address.host, st.address.port)
+                        == (client.host, client.port)
+                        and transport_retries < GLOBAL_CONFIG.rpc_max_retries
+                    ):
+                        # same live incarnation: dedup-protected re-push
+                        # (same request id) — no budget, streaming safe
+                        transport_retries += 1
+                        await asyncio.sleep(0.1)
+                        continue
+                    push_client = None
                     if (
                         st.state == "DEAD"
                         or retries_left <= 0

@@ -101,7 +101,13 @@ class NodeDaemon:
         self.node_id = NodeID.from_random()
         self.host = host
         self.server = RpcServer(host, port)
-        self.controller = RpcClient(controller_host, controller_port, name="controller")
+        # retry-by-default toward the control plane: every mutating call
+        # is dedup-stamped (core/rpc.py), so surviving a controller
+        # restart or a chaos'd reply is a transparent retry, not an error
+        self.controller = RpcClient(
+            controller_host, controller_port, name="controller",
+            default_retries=GLOBAL_CONFIG.rpc_max_retries,
+        )
         self.controller_addr = (controller_host, controller_port)
         res = dict(resources or {})
         res.setdefault("CPU", float(os.cpu_count() or 1))
@@ -142,6 +148,11 @@ class NodeDaemon:
         self._waiting_seq = 0
         self._last_oom_check = 0.0
         self._stopping = False
+        # relocation reports already delivered to the controller: its
+        # directory is in-memory only, so a restarted controller needs
+        # them REPLAYED after re-registration or owners mid-fetch would
+        # fall back to lineage reconstruction (bounded ring)
+        self._reported_moves: List[Dict[str, Any]] = []
         # drain protocol state (graceful preemption; see drain())
         self._draining = False
         self._drain_task: Optional[asyncio.Task] = None
@@ -322,6 +333,11 @@ class NodeDaemon:
             await self.controller.call(
                 "report_relocated", {"moves": moves}, timeout=10
             )
+            # remember what we told the controller: a controller restart
+            # mid-drain loses the directory, and the re-register path
+            # replays these (bounded like the controller-side ring)
+            self._reported_moves.extend(moves)
+            del self._reported_moves[:-4096]
             logger.info("drain: replicated %d object(s) off-node", len(moves))
 
     # ---- memory monitor (OOM killer) -----------------------------------
@@ -553,9 +569,24 @@ class NodeDaemon:
                 )
                 if reply.get("unknown_node"):
                     # controller restarted and lost node membership:
-                    # re-register, carrying held bundles for re-adoption
+                    # re-register (carrying held bundles for re-adoption)
+                    # and replay unacked session state — the relocation
+                    # reports live only in controller memory. Running
+                    # actors replay themselves on the next sync's
+                    # ``actors`` payload.
                     logger.info("controller does not know us — re-registering")
+                    from ray_tpu.observability.rpc_metrics import (
+                        CONTROLLER_RECONNECTS,
+                    )
+
+                    CONTROLLER_RECONNECTS.inc(labels={"role": "daemon"})
                     await self._register_with_controller(self.port)
+                    if self._reported_moves:
+                        await self.controller.call(
+                            "report_relocated",
+                            {"moves": list(self._reported_moves)},
+                            timeout=10,
+                        )
                     continue
                 self._view = [
                     _ViewNode(
@@ -1044,6 +1075,13 @@ class NodeDaemon:
             # races the controller's DRAINING exclusion: reschedule
             raise RuntimeError("node is draining; cannot host new actors")
         spec: TaskSpec = payload["spec"]
+        # Exactly-once guard for control-plane replays (a restarted
+        # controller rescheduling an actor it only half-persisted, or a
+        # dedup-window miss): if a live worker already hosts this actor
+        # id, report it instead of spawning a duplicate incarnation.
+        for w in self.workers.values():
+            if w.actor_id == spec.actor_id and w.proc.poll() is None:
+                return {"pid": w.pid}
         req = ResourceSet(spec.resources)
         bundle_key = None
         if isinstance(spec.scheduling_strategy, PlacementGroupScheduling):

@@ -4,12 +4,13 @@ Equivalent of the reference's gRPC layer (``src/ray/rpc/grpc_server.h``,
 ``rpc/client_call.h``, retrying client, fault injection
 ``rpc/rpc_chaos.h:23``) redesigned for this runtime: length-prefixed
 msgpack frames over TCP, one asyncio server per process, typed async
-handlers, a retrying client with exponential backoff, server-push
-subscription streams (the pubsub substrate), and env-configurable chaos
-injection for tests.
+handlers, a retrying client with jittered exponential backoff capped by
+the ambient ``core/deadline`` budget, server-push subscription streams
+(the pubsub substrate), request-id dedup for exactly-once-effective
+mutating RPCs, and seeded, config-driven chaos injection for tests.
 
 Frame format (all little-endian):
-    [u32 length] [msgpack: [kind, seq, method, payload_bytes]]
+    [u32 length] [msgpack: [kind, seq, method, payload_bytes, meta?]]
 
 kinds: 0=request, 1=reply-ok, 2=reply-err, 3=push (server-initiated,
 seq identifies the subscription), 4=batch (micro-batching: the payload
@@ -19,18 +20,33 @@ dispatches all of them from ONE read wakeup instead of a wakeup per
 frame; per-connection FIFO order is preserved).
 Payloads are pickled (cloudpickle-compatible dataclasses travel as-is);
 the store's bulk data paths use raw bytes to avoid copies.
+
+Exactly-once-effective mutating RPCs: a lost *reply* is
+indistinguishable from a lost *request*, so a blind retry of a mutating
+method duplicates its side effect. Requests for methods not classified
+in :data:`IDEMPOTENT_METHODS` therefore carry a 5th frame slot
+``meta = [client_id, request_id]`` (stable across every retry of one
+logical call); the server keeps a bounded reply cache keyed on that
+pair and answers duplicates from it instead of re-executing the
+handler. Duplicates racing the ORIGINAL execution await its in-flight
+future. The cache is bounded (``rpc_dedup_cache_entries`` /
+``rpc_dedup_cache_max_bytes``, oldest-first eviction) — a retry
+arriving after eviction re-executes, the same window the reference
+accepts for its GCS-side dedup tables.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import pickle
 import random
 import struct
 import threading
 import time
-from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
@@ -48,6 +64,41 @@ MAX_FRAME = 1 << 31
 #: drain()'s flow-control view at most one small flush stale)
 _FLUSH_BYTES = 1 << 20
 
+#: Methods safe to blind-retry because re-execution is a no-op (pure
+#: reads, monotonic position reports, pop-style releases). Everything
+#: NOT listed is classified dedup-required and gets request-id stamping
+#: — the safe default for unknown/mutating methods. This replaces the
+#: old binary retryable-flag thinking: idempotent methods retry without
+#: cache churn, mutating methods retry through the reply cache.
+IDEMPOTENT_METHODS = frozenset(
+    {
+        # liveness / handshakes / subscriptions (re-subscribe is safe)
+        "ping", "hello", "subscribe", "event_stats", "stats",
+        # periodic state sync (latest-wins by construction)
+        "sync_resources",
+        # pure reads
+        "nodes", "cluster_resources", "available_resources",
+        "autoscaler_demand", "kv_get", "kv_keys", "get_actor_info",
+        "get_named_actor", "list_named_actors", "get_pg", "get_named_pg",
+        "pg_table", "list_tasks", "list_actors", "list_objects",
+        "get_relocated", "get_object_meta", "object_info", "fetch_chunk",
+        "get_object_status",
+        # idempotent-by-construction object/worker ops
+        "pull_object", "adopt_object", "delete_object", "recover_object",
+        "stream_consumed", "cancel_task", "cancel_owned_task",
+        "kill_worker", "return_lease", "exit", "set_accelerator_env",
+        # drain entry points are idempotently guarded
+        "drain", "drain_node",
+    }
+)
+
+
+#: chaos retries use a short flat sleep (the server is demonstrably
+#: alive — injected faults are not congestion) and a generous attempt
+#: cap so sub-1.0 probabilities converge with overwhelming probability
+_CHAOS_RETRY_CAP = 25
+_CHAOS_RETRY_SLEEP_S = 0.02
+
 
 class RpcError(Exception):
     pass
@@ -62,16 +113,17 @@ class RemoteError(RpcError):
 
 
 class ChaosInjectedError(ConnectionLost):
-    """Injected fault (``testing_rpc_failure``). A ConnectionLost
-    subclass so every retry path treats it as a transient transport
-    failure — the reference rpc_chaos contract: injected failures are
-    RETRIED by the retrying client (they fire BEFORE the handler runs,
-    so a retry never double-executes), exercising retry handling rather
-    than fabricating app-level errors."""
+    """Injected fault (``testing_rpc_failure`` / ``testing_rpc_chaos``).
+    A ConnectionLost subclass so every retry path treats it as a
+    transient transport failure. ``request_drop`` faults fire BEFORE the
+    handler runs (a retry never double-executes); ``reply_drop`` faults
+    fire AFTER — the handler ran, and only the request-id dedup cache
+    makes the retry safe for mutating methods."""
 
 
 def _chaos_should_fail(method: str) -> bool:
-    """Fault injection (reference ``RAY_testing_rpc_failure``)."""
+    """Legacy pre-handler fault injection (reference
+    ``RAY_testing_rpc_failure``)."""
     spec = GLOBAL_CONFIG.testing_rpc_failure
     if not spec:
         return False
@@ -80,6 +132,59 @@ def _chaos_should_fail(method: str) -> bool:
     except ValueError:
         return False
     return (name == "*" or name == method) and random.random() < float(prob)
+
+
+_PLAN_LOCK = threading.Lock()
+_PLAN_KEY: Optional[Tuple[str, int]] = None
+_PLAN = None
+
+
+def active_fault_plan():
+    """The process-wide seeded fault plan for ``testing_rpc_chaos`` (or
+    None). Built lazily and rebuilt when the spec/seed config changes;
+    the seed is logged at activation so a failure reproduces from the
+    log alone (set ``RAY_TPU_testing_rpc_chaos_seed`` to replay)."""
+    spec = GLOBAL_CONFIG.testing_rpc_chaos
+    if not spec:
+        return None
+    global _PLAN_KEY, _PLAN
+    key = (spec, GLOBAL_CONFIG.testing_rpc_chaos_seed)
+    if _PLAN_KEY == key:
+        return _PLAN
+    with _PLAN_LOCK:
+        if _PLAN_KEY == key:
+            return _PLAN
+        from ray_tpu.util.chaos import RpcFaultPlan
+
+        seed = GLOBAL_CONFIG.testing_rpc_chaos_seed or (
+            int.from_bytes(os.urandom(4), "little") | 1
+        )
+        plan = RpcFaultPlan(spec, seed)
+        logger.warning(
+            "rpc chaos plan ACTIVE: spec=%r seed=%d "
+            "(reproduce: RAY_TPU_testing_rpc_chaos=%r "
+            "RAY_TPU_testing_rpc_chaos_seed=%d)",
+            spec, seed, spec, seed,
+        )
+        _PLAN, _PLAN_KEY = plan, key
+        return plan
+
+
+def _next_fault(method: str) -> Optional[Tuple[str, float]]:
+    """Consult both chaos knobs for this dispatch: the legacy
+    ``testing_rpc_failure`` (a request_drop) and the seeded fault plan."""
+    if _chaos_should_fail(method):
+        return ("request_drop", 0.0)
+    plan = active_fault_plan()
+    if plan is None:
+        return None
+    return plan.next_fault(method)
+
+
+def _count_injection(mode: str) -> None:
+    from ray_tpu.observability.rpc_metrics import RPC_CHAOS_INJECTIONS
+
+    RPC_CHAOS_INJECTIONS.inc(labels={"mode": mode})
 
 
 async def _read_frame(reader: asyncio.StreamReader):
@@ -102,9 +207,15 @@ def _iter_messages(msg):
         yield msgpack.unpackb(body, raw=True, use_list=True)
 
 
-def _encode_body(kind: int, seq: int, method: bytes, payload: bytes) -> bytes:
-    """A frame body WITHOUT the length prefix (the unit of batching)."""
-    return msgpack.packb([kind, seq, method, payload], use_bin_type=True)
+def _encode_body(
+    kind: int, seq: int, method: bytes, payload: bytes, meta=None
+) -> bytes:
+    """A frame body WITHOUT the length prefix (the unit of batching).
+    ``meta`` (requests only) is the dedup stamp ``[client_id,
+    request_id]``; 4-slot frames remain valid on the wire."""
+    if meta is None:
+        return msgpack.packb([kind, seq, method, payload], use_bin_type=True)
+    return msgpack.packb([kind, seq, method, payload, meta], use_bin_type=True)
 
 
 def _encode_frame(kind: int, seq: int, method: bytes, payload: bytes) -> bytes:
@@ -163,6 +274,12 @@ class RpcServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
         self.on_disconnect: Optional[Callable[["ServerConnection"], None]] = None
+        # request dedup / reply cache (exactly-once-effective mutating
+        # RPCs): SERVER-level, not per-connection — a retry after a
+        # reconnect must still find the original execution's reply.
+        self._dedup_done: "OrderedDict[Tuple[bytes, int], Tuple[int, bytes]]" = OrderedDict()
+        self._dedup_bytes = 0
+        self._dedup_inflight: Dict[Tuple[bytes, int], asyncio.Future] = {}
 
     def register(self, method: str, handler) -> None:
         self._handlers[method.encode()] = handler
@@ -189,11 +306,14 @@ class RpcServer:
                 # a BATCH frame dispatches all its requests from this ONE
                 # read wakeup, in queue order (micro-batching)
                 enqueued_at = time.monotonic()
-                for kind, seq, method, payload in _iter_messages(msg):
-                    if kind != REQUEST:
+                for m in _iter_messages(msg):
+                    if m[0] != REQUEST:
                         continue
                     asyncio.ensure_future(
-                        self._dispatch(conn, seq, method, payload, enqueued_at)
+                        self._dispatch(
+                            conn, m[1], m[2], m[3], enqueued_at,
+                            m[4] if len(m) > 4 else None,
+                        )
                     )
         finally:
             self._conns.discard(conn)
@@ -208,7 +328,15 @@ class RpcServer:
             except Exception:
                 pass
 
-    async def _dispatch(self, conn: "ServerConnection", seq: int, method: bytes, payload: bytes, enqueued_at: float = 0.0):
+    async def _dispatch(
+        self,
+        conn: "ServerConnection",
+        seq: int,
+        method: bytes,
+        payload: bytes,
+        enqueued_at: float = 0.0,
+        meta=None,
+    ):
         from ray_tpu.observability.event_stats import GLOBAL_EVENT_STATS
 
         handler = self._handlers.get(method)
@@ -216,13 +344,82 @@ class RpcServer:
         try:
             if handler is None:
                 raise RpcError(f"no handler for {method.decode()!r}")
-            if _chaos_should_fail(method.decode()):
+            method_name = method.decode()
+            fault = _next_fault(method_name)
+            reply_drop = False
+            if fault is not None:
+                mode, param = fault
+                _count_injection(mode)
+                if mode == "request_drop":
+                    # the request "never arrived": no handler, no dedup
+                    # record — a retry is trivially safe
+                    raise ChaosInjectedError(
+                        f"chaos: injected failure for {method_name}"
+                    )
+                if mode == "disconnect":
+                    # hard connection reset mid-call: nothing travels
+                    # back; the client's read loop fails its pending
+                    # calls with ConnectionLost and reconnects
+                    conn.abort()
+                    return
+                if mode == "delay":
+                    await asyncio.sleep(param)
+                elif mode == "reply_drop":
+                    reply_drop = True
+            # --- request dedup (exactly-once-effective) ---------------
+            dedup_key = None
+            if meta is not None:
+                dedup_key = (bytes(meta[0]), meta[1])
+                record = self._dedup_done.get(dedup_key)
+                if record is None:
+                    inflight = self._dedup_inflight.get(dedup_key)
+                    if inflight is not None:
+                        # the original execution is still running: wait
+                        # for ITS outcome instead of executing again
+                        try:
+                            record = await asyncio.shield(inflight)
+                        except BaseException:
+                            raise RpcError(
+                                "duplicate request raced a cancelled execution"
+                            )
+                if record is not None:
+                    self._count_dedup_hit(method_name)
+                    if reply_drop:
+                        raise ChaosInjectedError(
+                            f"chaos: reply dropped for {method_name} (dedup hit)"
+                        )
+                    await conn.send(record[0], seq, method, record[1])
+                    return
+                fut: asyncio.Future = asyncio.get_event_loop().create_future()
+                self._dedup_inflight[dedup_key] = fut
+            # --- execute ----------------------------------------------
+            try:
+                try:
+                    arg = pickle.loads(payload) if payload else None
+                    result = await handler(arg, conn)
+                    record = (REPLY_OK, pickle.dumps(result, protocol=5))
+                except Exception as e:  # noqa: BLE001 — reply with the error
+                    # the handler RAN (or its arguments were undecodable):
+                    # the error IS the outcome, and a retry must get the
+                    # same answer, not a second execution
+                    record = (REPLY_ERR, pickle.dumps(e))
+                if dedup_key is not None:
+                    self._dedup_record(dedup_key, record)
+            finally:
+                # a cancelled execution (server stopping) must not leave
+                # duplicate waiters parked on a future nobody resolves
+                if dedup_key is not None:
+                    stale = self._dedup_inflight.pop(dedup_key, None)
+                    if stale is not None and not stale.done():
+                        stale.cancel()
+            if reply_drop:
+                # the handler executed and its reply is cached — the lost
+                # reply is exactly the duplicate-execution trap; the
+                # client's retry must come back through the dedup path
                 raise ChaosInjectedError(
-                    f"chaos: injected failure for {method.decode()}"
+                    f"chaos: reply dropped for {method_name} after execution"
                 )
-            arg = pickle.loads(payload) if payload else None
-            result = await handler(arg, conn)
-            await conn.send(REPLY_OK, seq, method, pickle.dumps(result, protocol=5))
+            await conn.send(record[0], seq, method, record[1])
         except Exception as e:  # noqa: BLE001 — reply with the error
             try:
                 await conn.send(REPLY_ERR, seq, method, pickle.dumps(e))
@@ -234,6 +431,28 @@ class RpcServer:
                 started_at - enqueued_at if enqueued_at else 0.0,
                 time.monotonic() - started_at,
             )
+
+    def _dedup_record(self, key: Tuple[bytes, int], record: Tuple[int, bytes]) -> None:
+        """Resolve duplicate waiters and cache the reply, bounded by the
+        entry/byte caps with oldest-first eviction."""
+        fut = self._dedup_inflight.pop(key, None)
+        if fut is not None and not fut.done():
+            fut.set_result(record)
+        self._dedup_done[key] = record
+        self._dedup_bytes += len(record[1])
+        max_entries = GLOBAL_CONFIG.rpc_dedup_cache_entries
+        max_bytes = GLOBAL_CONFIG.rpc_dedup_cache_max_bytes
+        while self._dedup_done and (
+            len(self._dedup_done) > max_entries or self._dedup_bytes > max_bytes
+        ):
+            _, old = self._dedup_done.popitem(last=False)
+            self._dedup_bytes -= len(old[1])
+
+    @staticmethod
+    def _count_dedup_hit(method_name: str) -> None:
+        from ray_tpu.observability.rpc_metrics import RPC_DEDUP_HITS
+
+        RPC_DEDUP_HITS.inc(labels={"method": method_name})
 
     async def stop(self) -> None:
         # Close live connections first: in py3.12 ``wait_closed`` waits for
@@ -304,23 +523,55 @@ class ServerConnection:
         """Server-initiated message on a subscription channel."""
         await self.send(PUSH, channel, b"", pickle.dumps(payload, protocol=5))
 
+    def abort(self) -> None:
+        """Hard connection reset (chaos DISCONNECT): drop buffered
+        output and kill the transport without a FIN handshake, so the
+        peer sees a mid-call reset."""
+        self._closed = True
+        self._out = []
+        self._out_bytes = 0
+        try:
+            self.writer.transport.abort()
+        except Exception:
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
     @property
     def closed(self) -> bool:
         return self._closed
 
 
 class RpcClient:
-    """Retrying client (reference retryable gRPC client): reconnects with
-    exponential backoff; in-flight calls fail with ConnectionLost unless
-    the method is marked retryable."""
+    """Retrying client (reference retryable gRPC client): reconnects
+    with jittered exponential backoff capped by the ambient
+    ``core/deadline`` budget. Mutating methods (anything not in
+    :data:`IDEMPOTENT_METHODS`) are stamped with a (client id, request
+    id) pair held stable across retries, so a retried call lands in the
+    server's reply cache instead of re-executing — see the module
+    docstring. ``default_retries`` makes a client (e.g. the controller
+    client) retry-by-default without touching every call site."""
 
-    def __init__(self, host: str, port: int, *, name: str = ""):
+    def __init__(
+        self, host: str, port: int, *, name: str = "", default_retries: int = 0
+    ):
         self.host = host
         self.port = port
         self.name = name or f"{host}:{port}"
+        self.default_retries = default_retries
+        #: stable identity for the server's dedup cache; survives
+        #: reconnects of this client object (a NEW client = a new
+        #: logical caller = correctly never dedups against the old one)
+        self.client_id = os.urandom(12)
+        #: invoked (as a task) after every RE-connect — the hook for
+        #: re-subscribing push channels / replaying session state
+        self.on_reconnect: Optional[Callable[[], Awaitable[Any]]] = None
+        self._ever_connected = False
         self._reader = None
         self._writer = None
         self._seq = 0
+        self._rid = 0
         self._pending: Dict[int, asyncio.Future] = {}
         self._push_handlers: Dict[int, Callable[[Any], None]] = {}
         self._conn_lock: Optional[asyncio.Lock] = None
@@ -331,15 +582,27 @@ class RpcClient:
         self._out: list = []
         self._flush_scheduled = False
 
+    def next_request_id(self) -> int:
+        """Pre-allocate a dedup request id (io-loop only). Callers that
+        manage their own retry loops (actor task submission) pass it to
+        ``call(request_id=...)`` so every re-push of the same logical
+        operation shares one server-side dedup slot."""
+        self._rid += 1
+        return self._rid
+
     async def _ensure_connected(self, connect_timeout: Optional[float] = None):
         if self._conn_lock is None:
             self._conn_lock = asyncio.Lock()
+        reconnected = False
         async with self._conn_lock:
             if self._writer is not None and not self._writer.is_closing():
                 return
-            deadline = time.monotonic() + (
+            from ray_tpu.core.deadline import effective_timeout
+
+            budget = effective_timeout(
                 connect_timeout if connect_timeout is not None else GLOBAL_CONFIG.rpc_connect_timeout_s
             )
+            deadline = time.monotonic() + (budget if budget is not None else GLOBAL_CONFIG.rpc_connect_timeout_s)
             delay = GLOBAL_CONFIG.rpc_retry_base_delay_s
             while True:
                 try:
@@ -358,12 +621,29 @@ class RpcClient:
             self._read_task = asyncio.ensure_future(
                 self._read_loop(self._reader, self._writer, self._pending)
             )
+            reconnected = self._ever_connected
+            self._ever_connected = True
+        if reconnected and self.on_reconnect is not None and not self._closed:
+            # outside the lock (the hook's own calls re-enter it); as a
+            # task so the triggering call proceeds — pushes missed in
+            # the hook's in-flight window are the same gap any
+            # reconnect has, and the hook's replay covers it
+            asyncio.ensure_future(self._run_reconnect_hook())
+
+    async def _run_reconnect_hook(self) -> None:
+        try:
+            await self.on_reconnect()
+        except Exception:
+            logger.warning(
+                "on_reconnect hook for %s failed", self.name, exc_info=True
+            )
 
     async def _read_loop(self, reader, writer, pending):
         try:
             while True:
                 msg = await _read_frame(reader)
-                for kind, seq, method, payload in _iter_messages(msg):
+                for m in _iter_messages(msg):
+                    kind, seq, method, payload = m[0], m[1], m[2], m[3]
                     if kind == PUSH:
                         handler = self._push_handlers.get(seq)
                         if handler is not None:
@@ -402,22 +682,86 @@ class RpcClient:
         payload: Any = None,
         *,
         timeout: Optional[float] = None,
-        retries: int = 0,
+        retries: Optional[int] = None,
         connect_timeout: Optional[float] = None,
+        request_id: Optional[int] = None,
+        dedup: Optional[bool] = None,
     ):
+        """One logical RPC with retry-until-done semantics.
+
+        * ``retries``: transport-failure retry budget; None = this
+          client's ``default_retries``. ``timeout`` bounds each attempt.
+        * ``request_id``/``dedup``: every retry of one ``call()``
+          carries the SAME request id for dedup-required methods, so the
+          server answers a post-execution retry from its reply cache
+          instead of re-executing. Pass ``request_id`` (from
+          :meth:`next_request_id`) to extend that guarantee across a
+          caller-managed retry loop; ``dedup=False`` opts a call out.
+        * Chaos-injected faults are retried with a short flat sleep on a
+          separate generous budget (the server is alive by construction)
+          — a caller with ``retries=0`` still survives sub-certain
+          injection probabilities, matching the old pre-handler chaos
+          contract while the dedup cache keeps mutating retries safe.
+        * Backoff is jittered-exponential and, like the retry loop
+          itself, capped by the ambient ``core/deadline`` budget: an
+          expired budget raises the last failure instead of sleeping.
+        """
+        from ray_tpu.core.deadline import current_deadline
+
+        if retries is None:
+            retries = self.default_retries
+        if dedup is None:
+            dedup = (
+                GLOBAL_CONFIG.rpc_dedup_enabled
+                and method not in IDEMPOTENT_METHODS
+            )
+        rid = request_id
+        if rid is None and dedup:
+            rid = self.next_request_id()
+        ambient = current_deadline()
         attempt = 0
+        chaos_attempts = 0
         delay = GLOBAL_CONFIG.rpc_retry_base_delay_s
         while True:
             try:
-                return await self._call_once(method, payload, timeout, connect_timeout)
-            except (ConnectionLost, asyncio.TimeoutError):
+                return await self._call_once(
+                    method, payload, timeout, connect_timeout, rid if dedup else None
+                )
+            except ChaosInjectedError as e:
+                chaos_attempts += 1
+                if chaos_attempts > max(retries, _CHAOS_RETRY_CAP) or self._closed:
+                    raise
+                last_err: Exception = e
+                sleep_s = _CHAOS_RETRY_SLEEP_S * (0.5 + random.random())
+            except (ConnectionLost, asyncio.TimeoutError) as e:
                 attempt += 1
                 if attempt > retries or self._closed:
                     raise
-                await asyncio.sleep(delay)
+                last_err = e
+                sleep_s = delay * (0.5 + random.random() * 0.5)  # jitter
                 delay = min(delay * 2, GLOBAL_CONFIG.rpc_retry_max_delay_s)
+            self._count_retry(method)
+            if ambient is not None:
+                remaining = ambient.remaining()
+                if remaining <= 0:
+                    raise last_err  # budget exhausted: surface the failure
+                sleep_s = min(sleep_s, remaining)
+            await asyncio.sleep(sleep_s)
 
-    async def _call_once(self, method: str, payload: Any, timeout: Optional[float], connect_timeout: Optional[float] = None):
+    @staticmethod
+    def _count_retry(method: str) -> None:
+        from ray_tpu.observability.rpc_metrics import RPC_RETRIES
+
+        RPC_RETRIES.inc(labels={"method": method})
+
+    async def _call_once(
+        self,
+        method: str,
+        payload: Any,
+        timeout: Optional[float],
+        connect_timeout: Optional[float] = None,
+        request_id: Optional[int] = None,
+    ):
         await self._ensure_connected(connect_timeout)
         self._seq += 1
         seq = self._seq
@@ -425,7 +769,11 @@ class RpcClient:
         self._pending[seq] = fut
         try:
             body = _encode_body(
-                REQUEST, seq, method.encode(), pickle.dumps(payload, protocol=5)
+                REQUEST,
+                seq,
+                method.encode(),
+                pickle.dumps(payload, protocol=5),
+                None if request_id is None else [self.client_id, request_id],
             )
             self._out.append(body)
             self._out_bytes = getattr(self, "_out_bytes", 0) + len(body)

@@ -1,0 +1,44 @@
+"""RPC robustness counters (exported on every /metrics endpoint).
+
+Retry storms, duplicate-suppression activity, and chaos injection rates
+must be observable, not inferred from log archaeology: these counters are
+bumped by the transport layer (``core/rpc.py``) and the control-plane
+reconnect paths (``core/core_worker.py``, ``core/node_daemon.py``) and
+ride the same per-process registry as every other metric, so any process
+already serving /metrics (daemons, controller, serve replicas) exposes
+them for free.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.observability.metrics import Counter
+
+#: client-side RPC retry attempts (one inc per re-sent attempt)
+RPC_RETRIES = Counter(
+    "raytpu_rpc_retries_total",
+    "client RPC retry attempts, by method",
+    ("method",),
+)
+
+#: server-side duplicate requests answered from the reply cache — every
+#: hit is a handler re-execution that did NOT happen
+RPC_DEDUP_HITS = Counter(
+    "raytpu_rpc_dedup_hits_total",
+    "duplicate RPCs served from the server reply cache, by method",
+    ("method",),
+)
+
+#: injected faults, by mode (request_drop/reply_drop/delay/disconnect;
+#: the legacy testing_rpc_failure knob counts as request_drop)
+RPC_CHAOS_INJECTIONS = Counter(
+    "raytpu_rpc_chaos_injections_total",
+    "chaos faults injected into RPC dispatch, by mode",
+    ("mode",),
+)
+
+#: controller reconnect/re-register events (role: daemon|driver|worker)
+CONTROLLER_RECONNECTS = Counter(
+    "raytpu_controller_reconnects_total",
+    "controller connection re-establishments (re-register/re-subscribe)",
+    ("role",),
+)

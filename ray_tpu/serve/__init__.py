@@ -126,10 +126,13 @@ class DeploymentHandle:
 
     def call(self, *args, _timeout: Optional[float] = 60.0, _idempotent: bool = True, **kwargs):
         """Blocking retry-until-executed call (survives replica death
-        mid-rolling-update). AT-LEAST-ONCE by default — see
-        ``Router.execute`` for the retry contract; pass
-        ``_idempotent=False`` for non-idempotent requests so a
-        post-dispatch replica death propagates instead of re-executing."""
+        mid-rolling-update). Exactly-once-effective while the replica is
+        reachable (request-id dedup at the RPC layer absorbs lost
+        replies and connection resets); AT-LEAST-ONCE across replica
+        DEATH by default — see ``Router.execute`` for the full contract.
+        Pass ``_idempotent=False`` for non-idempotent requests so a
+        post-dispatch replica death propagates instead of re-executing
+        on a survivor."""
         return self._router.execute(
             "__call__", args, kwargs, model_id=self._model_id,
             timeout=_timeout, idempotent=_idempotent,

@@ -204,19 +204,25 @@ class Router:
         that lands on a dying replica re-chooses. App-level exceptions
         are NOT retried — only replica death/crash.
 
-        RETRY CONTRACT — this path is AT-LEAST-ONCE. The runtime cannot
-        tell "replica died before it saw the push" apart from "replica
-        executed (part of) the request, then died": both surface as
-        ActorDiedError from the result get. With ``idempotent=True``
-        (default) the router re-executes on a survivor either way, so a
-        non-idempotent request (LLM generation, a payment, an append) can
-        run twice after an unlucky crash. Pass ``idempotent=False`` to
-        auto-retry only when the push provably never reached a replica
-        (submission-side failure); a post-dispatch death then propagates
-        to the caller, who owns the dedupe/retry decision (e.g. resubmit
-        with the same request_id). Streaming callers get the tighter
-        contract for free: ``execute_stream`` only ever replays before
-        the first item.
+        RETRY CONTRACT. While the chosen replica is REACHABLE, every
+        call — idempotent or not — is exactly-once-effective: the actor
+        push rides the RPC layer's request-id dedup (core/rpc.py via
+        core_worker request-id reuse), so a lost reply or a transient
+        connection reset is retried transparently and answered from the
+        replica's reply cache instead of re-executing. What remains
+        AT-LEAST-ONCE is replica DEATH: the runtime cannot tell "replica
+        died before it saw the push" apart from "replica executed (part
+        of) the request, then died" — the reply cache died with the
+        process. With ``idempotent=True`` (default) the router
+        re-executes on a survivor either way, so a non-idempotent
+        request (LLM generation, a payment, an append) can run twice
+        after an unlucky crash. Pass ``idempotent=False`` to confine
+        auto-retry to the provably-safe cases (submission-side failure,
+        or the dedup-protected reachable-replica retries above); a
+        post-dispatch replica death then propagates to the caller, who
+        owns the cross-replica dedupe/retry decision. Streaming callers
+        get the tighter contract for free: ``execute_stream`` only ever
+        replays before the first item.
 
         One Deadline covers the whole call (core/deadline.py): dispatch
         retries AND the result get draw from the same budget, clamped by

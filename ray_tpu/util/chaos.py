@@ -20,7 +20,71 @@ import random
 import signal
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
+
+#: RPC chaos fault modes (see ``core/rpc.py`` injection points).
+#: request_drop — fails before the handler runs (a retry is trivially
+#:   safe; the reference rpc_chaos contract).
+#: reply_drop — the handler RUNS, then the reply is lost: the
+#:   duplicate-execution trap that request-id dedup exists to defuse.
+#: delay — latency injection before the handler (exercises client
+#:   timeouts racing in-flight executions).
+#: disconnect — hard connection reset mid-call (exercises reconnect +
+#:   cross-connection dedup).
+RPC_FAULT_MODES = ("request_drop", "reply_drop", "delay", "disconnect")
+
+
+class RpcFaultPlan:
+    """Seeded, per-method RPC fault plan (the post-execution upgrade of
+    the reference's ``rpc_chaos.h`` pre-handler-only injection).
+
+    Spec grammar (``RAY_TPU_testing_rpc_chaos``)::
+
+        "<method|*>:<mode>:<prob>[:<param>][, ...]"
+
+    e.g. ``"kv_put:reply_drop:0.4,*:delay:0.05:0.1"``. The first rule
+    whose method matches wins; ``param`` is the delay seconds for
+    ``delay`` (default 0.05) and ignored otherwise.
+
+    DETERMINISM CONTRACT: exactly one RNG draw per :meth:`next_fault`
+    consult, whether or not any rule matches — so the full injection
+    sequence is a pure function of (seed, the ordered sequence of
+    consulted method names). A failure log carrying the seed plus the
+    spec reproduces the exact fault schedule.
+    """
+
+    def __init__(self, spec: str, seed: int):
+        self.spec = spec
+        self.seed = seed
+        self.rules: List[Tuple[str, str, float, float]] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) < 3:
+                raise ValueError(f"bad rpc chaos rule {part!r} (need method:mode:prob)")
+            method, mode, prob = fields[0], fields[1], float(fields[2])
+            if mode not in RPC_FAULT_MODES:
+                raise ValueError(f"unknown rpc chaos mode {mode!r} (one of {RPC_FAULT_MODES})")
+            param = float(fields[3]) if len(fields) > 3 else 0.05
+            self.rules.append((method, mode, prob, param))
+        self._rng = random.Random(seed)
+        self.consults = 0
+        self.injections = 0
+
+    def next_fault(self, method: str) -> Optional[Tuple[str, float]]:
+        """One deterministic consult: ``(mode, param)`` to inject a fault
+        for this dispatch of ``method``, else None."""
+        draw = self._rng.random()  # ALWAYS one draw (see class docstring)
+        self.consults += 1
+        for rule_method, mode, prob, param in self.rules:
+            if rule_method == "*" or rule_method == method:
+                if draw < prob:
+                    self.injections += 1
+                    return (mode, param)
+                return None
+        return None
 
 
 def find_worker_pids(controller_addr: str) -> List[int]:

@@ -30,6 +30,7 @@ RUNTIME_ENTRYPOINTS = (
     "ray_tpu.core.worker_main",
     "ray_tpu.core.node_main",
     "ray_tpu.core.head_main",
+    "ray_tpu.core.controller_main",
 )
 
 ProcOrPid = Union[subprocess.Popen, int]

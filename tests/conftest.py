@@ -43,6 +43,17 @@ if "xla_force_host_platform_device_count" not in _flags:
 # importing ray_tpu (GLOBAL_CONFIG reads env at import).
 os.environ.setdefault("RAY_TPU_watchdog_abort_after_s", "120")
 
+# One chaos seed per SESSION, chosen here (before ray_tpu imports config)
+# and printed in the report header: every chaos-enabled test in this run
+# draws its fault plan from this seed, and spawned runtime processes
+# inherit it through env + system-config — so a chaos-test failure in a
+# tier-1 log is reproducible from the log alone by re-exporting the
+# printed RAY_TPU_testing_rpc_chaos_seed value.
+if not os.environ.get("RAY_TPU_testing_rpc_chaos_seed"):
+    os.environ["RAY_TPU_testing_rpc_chaos_seed"] = str(
+        int.from_bytes(os.urandom(3), "little") | 1
+    )
+
 import faulthandler  # noqa: E402
 
 import jax  # noqa: E402
@@ -90,11 +101,23 @@ def pytest_report_header(config):
     # is pytest's capture tempfile by dump time) — this header line is how
     # an operator staring at a silent crash finds the stacks
     if _DUMP_FILE is None:
-        return "hard-timeout stack dumps: DISABLED (could not open dump file)"
-    return (
-        f"hard-timeout stack dumps land in {_DUMP_PATH} "
-        "(silent exit-1 run? look there; last '[armed]' line names the test)"
+        lines = ["hard-timeout stack dumps: DISABLED (could not open dump file)"]
+    else:
+        lines = [
+            f"hard-timeout stack dumps land in {_DUMP_PATH} "
+            "(silent exit-1 run? look there; last '[armed]' line names the test)"
+        ]
+    # chaos reproducibility: any chaos-test failure in this log replays
+    # with these two env vars (tests that pin their own seed say so)
+    from ray_tpu.core.config import GLOBAL_CONFIG as _CFG
+
+    plan = _CFG.testing_rpc_chaos or "(none; chaos tests set per-test specs)"
+    lines.append(
+        f"rpc chaos: seed={_CFG.testing_rpc_chaos_seed} plan={plan} — "
+        "reproduce a chaos failure with "
+        f"RAY_TPU_testing_rpc_chaos_seed={_CFG.testing_rpc_chaos_seed}"
     )
+    return lines
 
 
 @pytest.fixture

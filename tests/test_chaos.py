@@ -110,6 +110,19 @@ def test_actor_workload_under_worker_chaos(chaos_cluster):
     assert kills, "killer never fired — chaos was a no-op"
 
 
+def test_find_worker_pids_scopes_to_cluster(chaos_cluster):
+    """The pid scanner must only see THIS cluster's workers."""
+
+    @ray_tpu.remote(num_cpus=0.5)
+    def touch():
+        return os.getpid()
+
+    pid = ray_tpu.get(touch.remote(), timeout=120)
+    pids = find_worker_pids(_controller_addr(chaos_cluster))
+    assert pid in pids
+    assert find_worker_pids("127.0.0.1:1") == []
+
+
 # slow: the in-gate equivalent is test_drain.py::
 # test_preemption_mid_training_resumes_from_urgent_checkpoint (same
 # restart-from-checkpoint path, plus the drain protocol on top)
@@ -119,6 +132,11 @@ def test_trainer_completes_under_node_chaos():
     checkpoint when the node hosting a train worker dies mid-run, and
     still converges (reference: Train fault tolerance =
     restart-worker-group-from-checkpoint)."""
+    # last in the module by construction: the module-scoped chaos_cluster
+    # fixture (used by every other test here) stays alive until module
+    # teardown, and this test needs its own fresh cluster — disconnect
+    # the fixture's driver first (shutdown is idempotent at teardown)
+    ray_tpu.shutdown()
     cluster = Cluster(num_cpus=1)
     cluster.add_node(num_cpus=2, resources={"trainer": 2})
     time.sleep(1.0)
@@ -185,16 +203,3 @@ def test_trainer_completes_under_node_chaos():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
-
-
-def test_find_worker_pids_scopes_to_cluster(chaos_cluster):
-    """The pid scanner must only see THIS cluster's workers."""
-
-    @ray_tpu.remote(num_cpus=0.5)
-    def touch():
-        return os.getpid()
-
-    pid = ray_tpu.get(touch.remote(), timeout=120)
-    pids = find_worker_pids(_controller_addr(chaos_cluster))
-    assert pid in pids
-    assert find_worker_pids("127.0.0.1:1") == []

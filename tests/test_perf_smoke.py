@@ -24,6 +24,19 @@ def _rate(fn, min_time=0.5):
     return total / (time.perf_counter() - start)
 
 
+def _floored_rate(fn, floor, min_time=0.5):
+    """Rate measurement that is LOAD-AWARE on failure: a single sample
+    below the floor re-measures twice more and judges the median-of-3 —
+    a transient box-load spike (the PR 4 full-suite flake) loses to the
+    two clean samples, while a real order-of-magnitude regression fails
+    all three. The healthy path stays one sample (no extra suite time)."""
+    first = _rate(fn, min_time)
+    if first >= floor:
+        return first
+    samples = sorted([first, _rate(fn, min_time), _rate(fn, min_time)])
+    return samples[1]
+
+
 def test_submit_hot_path_smoke():
     ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 4)))
     try:
@@ -43,8 +56,8 @@ def test_submit_hot_path_smoke():
             ray_tpu.get(noop.remote(), timeout=60)
             return 1
 
-        async_rate = _rate(tasks_async)
-        sync_rate = _rate(tasks_sync)
+        async_rate = _floored_rate(tasks_async, 250)
+        sync_rate = _floored_rate(tasks_sync, 25)
 
         # inline results: a small result is served from the in-process
         # cache — second get must not pay any RPC (sub-ms even cold-ish)

@@ -1,9 +1,18 @@
 import asyncio
+import contextlib
+import time
 
 import pytest
 
 from ray_tpu.core.config import GLOBAL_CONFIG
-from ray_tpu.core.rpc import IoThread, RemoteError, RpcClient, RpcServer
+from ray_tpu.core.rpc import (
+    ChaosInjectedError,
+    ConnectionLost,
+    IoThread,
+    RemoteError,
+    RpcClient,
+    RpcServer,
+)
 
 
 @pytest.fixture
@@ -11,6 +20,40 @@ def io():
     t = IoThread("test-io")
     yield t
     t.stop()
+
+
+@contextlib.contextmanager
+def chaos_plan(spec: str, seed: int = 1234):
+    """Activate a seeded fault plan for the duration of a test."""
+    old_spec = GLOBAL_CONFIG.testing_rpc_chaos
+    old_seed = GLOBAL_CONFIG.testing_rpc_chaos_seed
+    GLOBAL_CONFIG.testing_rpc_chaos = spec
+    GLOBAL_CONFIG.testing_rpc_chaos_seed = seed
+    try:
+        yield
+    finally:
+        GLOBAL_CONFIG.testing_rpc_chaos = old_spec
+        GLOBAL_CONFIG.testing_rpc_chaos_seed = old_seed
+
+
+def _counting_server(io, method="incr"):
+    """Server whose handler counts executions per key (the side-effect
+    detector every dedup test asserts against)."""
+    counts = {}
+
+    async def setup():
+        server = RpcServer()
+
+        async def incr(payload, ctx):
+            counts[payload] = counts.get(payload, 0) + 1
+            return ("ok", payload)
+
+        server.register(method, incr)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    return server, port, counts
 
 
 def test_basic_call(io):
@@ -289,5 +332,231 @@ def test_chaos_injection(io):
     finally:
         GLOBAL_CONFIG.testing_rpc_failure = ""
     assert io.run(client.call("ping")) == "pong"
+    io.run(client.close())
+    io.run(server.stop())
+
+
+# ---------------------------------------------------------------------------
+# seeded fault plan: four chaos modes + determinism
+
+
+def test_fault_plan_determinism():
+    """Same seed + same consult sequence ⇒ identical injection sequence
+    (the reproduce-from-the-log contract); a different seed diverges."""
+    from ray_tpu.util.chaos import RpcFaultPlan
+
+    spec = "kv_put:reply_drop:0.5,*:delay:0.2:0.01"
+    methods = ["kv_put", "ping", "kv_put", "submit", "kv_put", "ping"] * 50
+    a = RpcFaultPlan(spec, seed=7)
+    b = RpcFaultPlan(spec, seed=7)
+    seq_a = [a.next_fault(m) for m in methods]
+    seq_b = [b.next_fault(m) for m in methods]
+    assert seq_a == seq_b
+    assert a.consults == len(methods)
+    assert any(f is not None for f in seq_a)  # the plan actually fires
+    c = RpcFaultPlan(spec, seed=8)
+    assert [c.next_fault(m) for m in methods] != seq_a
+
+
+def test_fault_plan_rejects_bad_spec():
+    from ray_tpu.util.chaos import RpcFaultPlan
+
+    with pytest.raises(ValueError, match="unknown rpc chaos mode"):
+        RpcFaultPlan("kv_put:explode:0.5", seed=1)
+    with pytest.raises(ValueError, match="need method:mode:prob"):
+        RpcFaultPlan("kv_put", seed=1)
+
+
+def test_chaos_request_drop_mode(io):
+    """request_drop fires BEFORE the handler: at prob 1.0 the call fails
+    (after the internal chaos-retry budget) and the handler NEVER ran."""
+    server, port, counts = _counting_server(io)
+    client = RpcClient("127.0.0.1", port)
+    with chaos_plan("incr:request_drop:1.0"):
+        with pytest.raises(ChaosInjectedError):
+            io.run(client.call("incr", "a"))
+    assert counts == {}
+    assert io.run(client.call("incr", "a")) == ("ok", "a")
+    assert counts == {"a": 1}
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_chaos_delay_mode(io):
+    """delay injects latency before the handler and otherwise leaves the
+    call intact."""
+    server, port, counts = _counting_server(io)
+    client = RpcClient("127.0.0.1", port)
+    with chaos_plan("incr:delay:1.0:0.2"):
+        t0 = time.monotonic()
+        assert io.run(client.call("incr", "a")) == ("ok", "a")
+        assert time.monotonic() - t0 >= 0.2
+    assert counts == {"a": 1}
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_chaos_disconnect_mode(io):
+    """disconnect hard-resets the connection mid-call: the client sees
+    ConnectionLost (NOT a chaos reply), reconnects, and a later call
+    succeeds once injection stops."""
+    server, port, counts = _counting_server(io)
+    client = RpcClient("127.0.0.1", port)
+    with chaos_plan("incr:disconnect:1.0"):
+        with pytest.raises(ConnectionLost):
+            io.run(client.call("incr", "a", retries=2, connect_timeout=2.0))
+    assert counts == {}  # reset fired before the handler
+    assert io.run(client.call("incr", "a")) == ("ok", "a")
+    assert counts == {"a": 1}
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_reply_drop_dedup_executes_exactly_once(io):
+    """THE duplicate-execution trap: reply_drop runs the handler then
+    loses the reply. With request-id dedup the retries are answered from
+    the reply cache — every mutating op lands exactly once across N
+    retries, and the dedup-hit counter proves the cache did the work."""
+    from ray_tpu.observability import metrics as m
+    from ray_tpu.observability.rpc_metrics import RPC_DEDUP_HITS
+
+    server, port, counts = _counting_server(io)
+    client = RpcClient("127.0.0.1", port)
+    before = RPC_DEDUP_HITS._values.get(("incr",), 0.0)
+    with chaos_plan("incr:reply_drop:0.5", seed=42):
+
+        async def many():
+            return await asyncio.gather(
+                *[client.call("incr", i, retries=50) for i in range(40)]
+            )
+
+        out = io.run(many())
+    assert sorted(p for _ok, p in out) == list(range(40))
+    assert {k: v for k, v in counts.items() if v != 1} == {}
+    assert RPC_DEDUP_HITS._values.get(("incr",), 0.0) > before
+    # counters reach the Prometheus exposition too
+    assert "raytpu_rpc_dedup_hits_total" in m.render()
+    assert 'raytpu_rpc_chaos_injections_total{mode="reply_drop"}' in m.render()
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_reply_drop_without_dedup_duplicates(io):
+    """Negative control: with dedup opted out, a reply_drop retry
+    re-executes the handler — the duplicate the cache exists to stop."""
+    server, port, counts = _counting_server(io)
+    client = RpcClient("127.0.0.1", port)
+    with chaos_plan("incr:reply_drop:0.5", seed=42):
+
+        async def many():
+            return await asyncio.gather(
+                *[
+                    client.call("incr", i, retries=50, dedup=False)
+                    for i in range(20)
+                ]
+            )
+
+        io.run(many())
+    assert any(v > 1 for v in counts.values()), counts
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_duplicate_in_flight_request_executes_once(io):
+    """A duplicate arriving while the ORIGINAL execution is still running
+    awaits its in-flight future instead of executing again."""
+    calls = {"n": 0}
+
+    async def setup():
+        server = RpcServer()
+
+        async def slow_incr(payload, ctx):
+            calls["n"] += 1
+            await asyncio.sleep(0.3)
+            return calls["n"]
+
+        server.register("slow_incr", slow_incr)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    client = RpcClient("127.0.0.1", port)
+
+    async def dup():
+        rid = client.next_request_id()
+        return await asyncio.gather(
+            client.call("slow_incr", None, request_id=rid),
+            client.call("slow_incr", None, request_id=rid),
+        )
+
+    assert io.run(dup()) == [1, 1]
+    assert calls["n"] == 1
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_dedup_cache_eviction_bounded_oldest_first(io):
+    """The reply cache is bounded: over the entry cap the OLDEST entries
+    evict first; a byte cap alone also bounds it."""
+    server, port, counts = _counting_server(io)
+    client = RpcClient("127.0.0.1", port)
+    old_entries = GLOBAL_CONFIG.rpc_dedup_cache_entries
+    old_bytes = GLOBAL_CONFIG.rpc_dedup_cache_max_bytes
+    try:
+        GLOBAL_CONFIG.rpc_dedup_cache_entries = 4
+        for i in range(6):
+            io.run(client.call("incr", i))
+        assert len(server._dedup_done) == 4
+        kept_rids = sorted(k[1] for k in server._dedup_done)
+        assert kept_rids == kept_rids[:1] + list(
+            range(kept_rids[0] + 1, kept_rids[0] + 4)
+        )  # contiguous newest window
+        all_rids_seen = 6
+        assert min(kept_rids) > all_rids_seen - 4  # oldest two are gone
+        # byte cap: small enough that every insert immediately evicts
+        GLOBAL_CONFIG.rpc_dedup_cache_entries = old_entries
+        GLOBAL_CONFIG.rpc_dedup_cache_max_bytes = 1
+        io.run(client.call("incr", 99))
+        assert len(server._dedup_done) == 0
+        assert server._dedup_bytes == 0
+    finally:
+        GLOBAL_CONFIG.rpc_dedup_cache_entries = old_entries
+        GLOBAL_CONFIG.rpc_dedup_cache_max_bytes = old_bytes
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_retry_backoff_capped_by_ambient_deadline(io):
+    """The retry loop's backoff (and stop condition) honors the ambient
+    core/deadline budget: with the server gone, a generous retry budget
+    still fails within the deadline instead of sleeping through it."""
+    from ray_tpu.core.deadline import deadline_scope
+
+    server, port, _counts = _counting_server(io)
+    io.run(server.stop())
+
+    client = RpcClient("127.0.0.1", port)
+
+    async def run():
+        with deadline_scope(0.5):
+            await client.call("incr", 1, retries=50, connect_timeout=0.1)
+
+    t0 = time.monotonic()
+    with pytest.raises((ConnectionLost, asyncio.TimeoutError)):
+        io.run(run())
+    assert time.monotonic() - t0 < 3.0
+    io.run(client.close())
+
+
+def test_rpc_retry_counter_increments(io):
+    from ray_tpu.observability.rpc_metrics import RPC_RETRIES
+
+    server, port, counts = _counting_server(io)
+    client = RpcClient("127.0.0.1", port)
+    before = RPC_RETRIES._values.get(("incr",), 0.0)
+    with chaos_plan("incr:reply_drop:0.5", seed=43):
+        io.run(client.call("incr", "x", retries=50))
+    assert RPC_RETRIES._values.get(("incr",), 0.0) > before
+    assert counts == {"x": 1}
     io.run(client.close())
     io.run(server.stop())

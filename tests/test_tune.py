@@ -212,10 +212,17 @@ def test_experiment_snapshot_and_resume(cluster, tmp_path):
                 {"i": i, "x": config["x"], "start": start},
                 checkpoint={"i": i + 1},
             )
-            # first run is slow so the driver can "die" mid-sweep;
-            # the resumed run sees the marker gone and finishes fast
-            if _os.path.exists(str(config["marker"])):
-                time.sleep(0.3)
+            # BARRIER, not pacing (deflake): while the marker exists the
+            # first run PARKS after each checkpointed report, so the
+            # mid-run snapshot capture below cannot race trial progress
+            # on a loaded box (the PR 1/PR 4 residual timing flake — a
+            # fixed per-report sleep let fast trials finish before a
+            # resumable snapshot existed). The test removes the marker
+            # once it has its copy; the cap bounds a capture failure.
+            waited = 0.0
+            while _os.path.exists(str(config["marker"])) and waited < 20.0:
+                time.sleep(0.1)
+                waited += 0.1
 
     run_config = RunConfig(name="resume_exp", storage_path=str(tmp_path))
     tuner = tune.Tuner(
