@@ -164,6 +164,23 @@ class GlobalConfig:
     #: so consumers re-fetch instead of paying lineage reconstruction
     drain_flush_objects: bool = True
 
+    # --- serve routing (serve/router.py, serve/replica.py) ---
+    #: how often a replica hosting a gossip-capable callable (one that
+    #: exposes ``routing_stats()``, e.g. an LLM engine) pushes its load +
+    #: prefix digest to the serve controller (propagated to routers via
+    #: the long-poll channel). <= 0 disables the reporter thread.
+    serve_replica_stats_period_s: float = 0.25
+    #: routing stats older than this fall back to pow-2 choice — a
+    #: stale digest must not keep steering traffic at a replica whose
+    #: cache (or queue) has moved on
+    serve_routing_stats_ttl_s: float = 5.0
+    #: cache-affinity blend weight: a replica's score is
+    #: outstanding_tokens - weight * matched_prefix_tokens, lowest wins.
+    #: 1.0 values a cached token exactly as much as a token of queue
+    #: backlog (it removes one prefill token of work); raise it to pin
+    #: conversations harder, 0 disables affinity (pure least-tokens).
+    serve_affinity_weight: float = 1.0
+
     # --- runtime_env ---
     #: TTL on the driver-side working_dir/py_modules change-signature
     #: cache: within this window a .remote() carrying a runtime_env

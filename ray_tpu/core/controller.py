@@ -410,7 +410,9 @@ class Controller:
             # re-registration (e.g. a dedup-window miss replaying after a
             # chaos'd reply): don't leak the old client's read task
             asyncio.ensure_future(stale.close())
-        self.node_clients[info.node_id] = RpcClient(info.host, info.port, name="noded")
+        self.node_clients[info.node_id] = RpcClient(
+            info.host, info.port, name="noded", role="noded"
+        )
         # Re-adoption: a (re)registering daemon reports the PG bundles it
         # still holds; a restarted controller reattaches them to RESTORING
         # PGs instead of double-reserving elsewhere.

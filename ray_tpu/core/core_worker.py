@@ -185,8 +185,11 @@ class CoreWorker(RuntimeBackend):
             self.controller = RpcClient(
                 controller_host, controller_port, name="controller",
                 default_retries=GLOBAL_CONFIG.rpc_max_retries,
+                role="controller",
             )
-            self.daemon = RpcClient(daemon_host, daemon_port, name="noded")
+            self.daemon = RpcClient(
+                daemon_host, daemon_port, name="noded", role="noded"
+            )
             self.controller.subscribe_push(ACTOR_PUSH_CHANNEL, self._on_actor_push)
             self.controller.subscribe_push(PG_PUSH_CHANNEL, self._on_pg_push)
             self.controller.subscribe_push(NODE_PUSH_CHANNEL, self._on_node_push)
@@ -241,22 +244,30 @@ class CoreWorker(RuntimeBackend):
 
     # ------------------------------------------------------------------
     # client cache
-    def _client(self, host: str, port: int) -> RpcClient:
+    def _client(self, host: str, port: int, role: Optional[str] = None) -> RpcClient:
+        """Cached peer client. ``role`` tags the SERVER's role for the
+        per-role idempotent-method classification (core/rpc.py) — one
+        address is one server, so a later tagged lookup may upgrade an
+        untagged cache entry, never flip an existing tag."""
         key = (host, port)
         c = self._clients.get(key)
         if c is None:
-            c = self._clients[key] = RpcClient(host, port, name=f"peer-{port}")
+            c = self._clients[key] = RpcClient(
+                host, port, name=f"peer-{port}", role=role
+            )
             # stream items ride back over the submission connection
             from ray_tpu.core.streaming import STREAM_PUSH_CHANNEL
 
             c.subscribe_push(STREAM_PUSH_CHANNEL, self._on_stream_item)
+        elif c.role is None and role is not None:
+            c.role = role
         return c
 
     def _owner_client(self, ref: ObjectRef) -> RpcClient:
         addr = ref.owner_address
         if addr is None:
             raise OwnerDiedError(ref.id(), "ref has no owner address")
-        return self._client(addr.host, addr.port)
+        return self._client(addr.host, addr.port, role="worker")
 
     # ------------------------------------------------------------------
     # objects: put
@@ -826,7 +837,7 @@ class CoreWorker(RuntimeBackend):
                 finally:
                     try:
                         await self._client(
-                            grant["daemon_host"], grant["daemon_port"]
+                            grant["daemon_host"], grant["daemon_port"], role="noded"
                         ).call("return_lease", {"lease_id": grant["lease_id"]})
                     except Exception:
                         pass
@@ -853,7 +864,7 @@ class CoreWorker(RuntimeBackend):
     async def _drain_on_lease(self, key, q: "_ClassQueue", grant: Dict[str, Any]) -> None:
         """Push queued specs onto one held lease until the queue runs dry
         (with a short linger for stragglers) or the worker dies."""
-        worker_client = self._client(grant["host"], grant["port"])
+        worker_client = self._client(grant["host"], grant["port"], role="worker")
         loop = asyncio.get_event_loop()
         while True:
             if not q.specs:
@@ -1051,7 +1062,7 @@ class CoreWorker(RuntimeBackend):
 
         async def _send():
             try:
-                await self._client(host, port).call(
+                await self._client(host, port, role="worker").call(
                     "stream_consumed",
                     {"task_id": task_id, "consumed": index},
                     timeout=10,
@@ -1166,7 +1177,7 @@ class CoreWorker(RuntimeBackend):
             target = await self._pg_lease_target(spec.scheduling_strategy)
             if target is not None:
                 daemon_addr = target
-                daemon = self._client(*target)
+                daemon = self._client(*target, role="noded")
         deadline = time.monotonic() + GLOBAL_CONFIG.worker_lease_timeout_s * 10
         infeasible_since: Optional[float] = None
         while True:
@@ -1190,7 +1201,7 @@ class CoreWorker(RuntimeBackend):
                 return g
             if "spillback" in reply:
                 host, port = reply["spillback"]
-                daemon = self._client(host, port)
+                daemon = self._client(host, port, role="noded")
                 daemon_addr = (host, port)
                 continue
             if reply.get("infeasible"):
@@ -1212,7 +1223,7 @@ class CoreWorker(RuntimeBackend):
                 target = await self._pg_lease_target(spec.scheduling_strategy)
                 if target is not None:
                     daemon_addr = target
-                    daemon = self._client(*target)
+                    daemon = self._client(*target, role="noded")
             else:
                 # fall back to local daemon (cluster may have changed)
                 daemon = self.daemon
@@ -1475,7 +1486,7 @@ class CoreWorker(RuntimeBackend):
                             s, ActorDiedError(actor_id, st.reason or "actor is dead")
                         )
                     return
-                client = self._client(st.address.host, st.address.port)
+                client = self._client(st.address.host, st.address.port, role="worker")
                 if client is not push_client:
                     push_client = client
                     push_rid = client.next_request_id()
@@ -1601,7 +1612,7 @@ class CoreWorker(RuntimeBackend):
                         spec, ActorDiedError(spec.actor_id, st.reason or "actor is dead")
                     )
                     return
-                client = self._client(st.address.host, st.address.port)
+                client = self._client(st.address.host, st.address.port, role="worker")
                 if client is not push_client:
                     push_client = client
                     push_rid = client.next_request_id()
@@ -1746,7 +1757,7 @@ class CoreWorker(RuntimeBackend):
 
             async def _send():
                 try:
-                    await self._client(host, port).call(
+                    await self._client(host, port, role="worker").call(
                         "cancel_task", {"task_id": tid, "force": force}, timeout=10
                     )
                 except Exception:

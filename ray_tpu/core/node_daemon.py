@@ -107,6 +107,7 @@ class NodeDaemon:
         self.controller = RpcClient(
             controller_host, controller_port, name="controller",
             default_retries=GLOBAL_CONFIG.rpc_max_retries,
+            role="controller",
         )
         self.controller_addr = (controller_host, controller_port)
         res = dict(resources or {})
@@ -703,7 +704,9 @@ class NodeDaemon:
         w.registered = True
         w.conn = conn
         conn.peer_tags["worker_token"] = token
-        w.client = RpcClient(w.host, w.port, name=f"worker-{token[:6]}")
+        w.client = RpcClient(
+            w.host, w.port, name=f"worker-{token[:6]}", role="worker"
+        )
         spec = self._pending_actor_specs.pop(token, None)
         if spec is not None:
             asyncio.ensure_future(self._run_actor_creation(w, spec))
@@ -1245,7 +1248,10 @@ class NodeDaemon:
         key = (host, port)
         client = self._peer_clients.get(key)
         if client is None:
-            client = self._peer_clients[key] = RpcClient(host, port, name=f"peer-{port}")
+            # peers of a daemon are other daemons (object transfer)
+            client = self._peer_clients[key] = RpcClient(
+                host, port, name=f"peer-{port}", role="noded"
+            )
         return client
 
     # ---- misc ----------------------------------------------------------

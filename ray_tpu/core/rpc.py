@@ -24,7 +24,8 @@ the store's bulk data paths use raw bytes to avoid copies.
 Exactly-once-effective mutating RPCs: a lost *reply* is
 indistinguishable from a lost *request*, so a blind retry of a mutating
 method duplicates its side effect. Requests for methods not classified
-in :data:`IDEMPOTENT_METHODS` therefore carry a 5th frame slot
+in :data:`IDEMPOTENT_METHODS` (per SERVER ROLE — the client is tagged
+with the role it talks to) therefore carry a 5th frame slot
 ``meta = [client_id, request_id]`` (stable across every retry of one
 logical call); the server keeps a bounded reply cache keyed on that
 pair and answers duplicates from it instead of re-executing the
@@ -65,32 +66,74 @@ MAX_FRAME = 1 << 31
 _FLUSH_BYTES = 1 << 20
 
 #: Methods safe to blind-retry because re-execution is a no-op (pure
-#: reads, monotonic position reports, pop-style releases). Everything
-#: NOT listed is classified dedup-required and gets request-id stamping
-#: — the safe default for unknown/mutating methods. This replaces the
-#: old binary retryable-flag thinking: idempotent methods retry without
-#: cache churn, mutating methods retry through the reply cache.
-IDEMPOTENT_METHODS = frozenset(
-    {
-        # liveness / handshakes / subscriptions (re-subscribe is safe)
-        "ping", "hello", "subscribe", "event_stats", "stats",
-        # periodic state sync (latest-wins by construction)
-        "sync_resources",
-        # pure reads
-        "nodes", "cluster_resources", "available_resources",
-        "autoscaler_demand", "kv_get", "kv_keys", "get_actor_info",
-        "get_named_actor", "list_named_actors", "get_pg", "get_named_pg",
-        "pg_table", "list_tasks", "list_actors", "list_objects",
-        "get_relocated", "get_object_meta", "object_info", "fetch_chunk",
-        "get_object_status",
-        # idempotent-by-construction object/worker ops
-        "pull_object", "adopt_object", "delete_object", "recover_object",
-        "stream_consumed", "cancel_task", "cancel_owned_task",
-        "kill_worker", "return_lease", "exit", "set_accelerator_env",
-        # drain entry points are idempotently guarded
-        "drain", "drain_node",
-    }
-)
+#: reads, monotonic position reports, pop-style releases), NAMESPACED BY
+#: SERVER ROLE: idempotency is a property of one service's handler, not
+#: of a method NAME — "stats" being a pure read on the node daemon says
+#: nothing about a future mutating "stats" on some other server, and a
+#: process-global set would silently skip dedup for it (the PR 5 review
+#: finding this fixes). Clients are tagged with the role of the server
+#: they talk to (``RpcClient(role=...)``); everything not listed for
+#: that role gets request-id stamping — the safe default for
+#: unknown/mutating methods. Idempotent methods retry without cache
+#: churn, mutating methods retry through the reply cache.
+IDEMPOTENT_METHODS: Dict[str, frozenset] = {
+    # the cluster controller (core/controller.py, c_* handlers)
+    "controller": frozenset(
+        {
+            # liveness / subscriptions (re-subscribe is safe)
+            "ping", "subscribe", "event_stats",
+            # periodic state sync (latest-wins by construction)
+            "sync_resources",
+            # pure reads
+            "nodes", "cluster_resources", "available_resources",
+            "autoscaler_demand", "kv_get", "kv_keys", "get_actor_info",
+            "get_named_actor", "list_named_actors", "get_pg",
+            "get_named_pg", "pg_table", "list_tasks", "list_actors",
+            "list_objects", "get_relocated",
+            # idempotently guarded (DRAINING is a terminal latch)
+            "drain_node",
+        }
+    ),
+    # node daemons (core/node_daemon.py, d_* handlers)
+    "noded": frozenset(
+        {
+            "ping", "hello", "event_stats", "stats",
+            # pure reads over the object directory/store
+            "list_objects", "get_object_meta", "object_info",
+            "fetch_chunk",
+            # idempotent-by-construction object/worker ops
+            "pull_object", "adopt_object", "delete_object",
+            "kill_worker", "return_lease",
+            # drain entry point is idempotently guarded
+            "drain",
+        }
+    ),
+    # core workers (core/core_worker.py, w_* handlers)
+    "worker": frozenset(
+        {
+            "ping",
+            # pure reads / monotonic position reports
+            "get_object_status", "stream_consumed",
+            # idempotent-by-construction ops
+            "cancel_task", "cancel_owned_task", "recover_object",
+            "delete_object", "exit", "set_accelerator_env",
+        }
+    ),
+}
+
+#: legacy union view for UNTAGGED clients (ad-hoc tools, tests driving a
+#: bare RpcServer): preserves the pre-namespacing classification rather
+#: than changing their wire behavior under them. Runtime clients are all
+#: role-tagged and get the per-role set.
+_IDEMPOTENT_ANY = frozenset().union(*IDEMPOTENT_METHODS.values())
+
+
+def idempotent_methods(role: Optional[str] = None) -> frozenset:
+    """The idempotent-method classification for one server role; the
+    legacy union for ``None``/unknown roles (see above)."""
+    if role is None:
+        return _IDEMPOTENT_ANY
+    return IDEMPOTENT_METHODS.get(role, _IDEMPOTENT_ANY)
 
 
 #: chaos retries use a short flat sleep (the server is demonstrably
@@ -554,12 +597,23 @@ class RpcClient:
     client) retry-by-default without touching every call site."""
 
     def __init__(
-        self, host: str, port: int, *, name: str = "", default_retries: int = 0
+        self,
+        host: str,
+        port: int,
+        *,
+        name: str = "",
+        default_retries: int = 0,
+        role: Optional[str] = None,
     ):
         self.host = host
         self.port = port
         self.name = name or f"{host}:{port}"
         self.default_retries = default_retries
+        #: role of the SERVER this client talks to ("controller" /
+        #: "noded" / "worker"): selects which per-role idempotent-method
+        #: set skips dedup stamping. None (untagged) uses the legacy
+        #: union — see IDEMPOTENT_METHODS.
+        self.role = role
         #: stable identity for the server's dedup cache; survives
         #: reconnects of this client object (a NEW client = a new
         #: logical caller = correctly never dedups against the old one)
@@ -713,7 +767,7 @@ class RpcClient:
         if dedup is None:
             dedup = (
                 GLOBAL_CONFIG.rpc_dedup_enabled
-                and method not in IDEMPOTENT_METHODS
+                and method not in idempotent_methods(self.role)
             )
         rid = request_id
         if rid is None and dedup:

@@ -89,6 +89,17 @@ class EngineConfig:
     #: after a tokens() timeout without cancel()) would otherwise pin its
     #: queue in the replica forever. <= 0 disables.
     finished_stream_ttl_s: float = 300.0
+    #: prefix caching (kv_cache.py): full blocks are indexed by token
+    #: chain-hash and SHARED with later requests whose prompt prefix
+    #: matches — those skip the covered prefill chunks entirely (the
+    #: warm-TTFT path for fleets of conversations sharing one system
+    #: prompt). Numerically inert: shared KV values are exactly what an
+    #: uncached prefill would have written.
+    prefix_cache_enabled: bool = True
+    #: cap on indexed blocks (0 = bounded only by the pool; unreferenced
+    #: cached blocks are reclaimed LRU-first whenever allocation needs
+    #: them, so the cache never starves admission)
+    prefix_cache_max_blocks: int = 0
 
     def resolved_prefill_buckets(self, max_seq_len: int) -> Sequence[int]:
         if self.prefill_buckets is not None:
@@ -148,6 +159,18 @@ def _engine_metrics():
         "preemptions_total": Counter(
             "raytpu_llm_preemptions_total", "requests evicted for blocks"
         ),
+        "prefix_hits_total": Counter(
+            "raytpu_llm_prefix_hits_total",
+            "admissions that reused cached prefix blocks",
+        ),
+        "prefix_tokens_saved_total": Counter(
+            "raytpu_llm_prefix_tokens_saved_total",
+            "prompt tokens whose prefill was skipped via the prefix cache",
+        ),
+        "cow_copies_total": Counter(
+            "raytpu_llm_cow_copies_total",
+            "copy-on-write block duplications (full-prompt cache hits)",
+        ),
     }
 
 
@@ -176,7 +199,12 @@ class InferenceEngine:
             decode_buckets=decode_buckets,
             cache_dtype=ec.cache_dtype,
         )
-        self.blocks = PagedBlockManager(ec.num_blocks, ec.block_size)
+        self.blocks = PagedBlockManager(
+            ec.num_blocks,
+            ec.block_size,
+            prefix_cache_enabled=ec.prefix_cache_enabled,
+            prefix_cache_max_blocks=ec.prefix_cache_max_blocks,
+        )
         self.scheduler = ContinuousBatchingScheduler(
             self.blocks,
             max_decode_batch=ec.max_decode_batch,
@@ -203,6 +231,7 @@ class InferenceEngine:
         self._ttfts: deque = deque(maxlen=512)
         self._token_times: deque = deque(maxlen=2048)
         self._preempt_seen = 0
+        self._prefix_seen: Dict[str, int] = {}
         self.total_steps = 0
         if ec.warmup:
             self.runner.warmup()
@@ -468,6 +497,13 @@ class InferenceEngine:
         t0_us = timeline._now_us()
         n_prefill_tokens = 0
         for req, start, chunk in plan.prefills:
+            if req.pending_cow:
+                # prefix-cache COW: duplicate the shared block(s) BEFORE
+                # this chunk writes into the private copies, then drop
+                # the source pins (the copies are live in the table now)
+                self.runner.copy_blocks(req.pending_cow)
+                self.blocks.cow_copied(req.request_id)
+                req.pending_cow = []
             row = self.blocks.table_row(req.request_id, self.runner.max_blocks_per_seq)
             prompt = req.effective_prompt
             logits = self.runner.prefill_chunk(
@@ -476,6 +512,9 @@ class InferenceEngine:
             req.prefill_pos = start + chunk
             n_prefill_tokens += chunk
             if req.prefill_done:
+                # the prompt's K/V is fully written: index its full
+                # blocks so later requests sharing the prefix skip them
+                self.blocks.register_prefix(req.request_id, prompt)
                 req.state = DECODE
                 self._emit_token(req, self._sample(req, logits))
 
@@ -539,6 +578,14 @@ class InferenceEngine:
             len(req.generated) >= req.max_new_tokens
             or (req.eos_token is not None and token == req.eos_token)
         )
+        if done:
+            # index the finished conversation's full blocks (multi-turn
+            # reuse) BEFORE finish() releases them to the cache LRU.
+            # Only positions whose K/V is actually written qualify: the
+            # final sampled token's K/V never was (its decode step never
+            # runs), so the registered prefix stops one token short.
+            written = (req.prompt + req.generated)[: req.context_len - 1]
+            self.blocks.register_prefix(req.request_id, written)
         if done and self.scheduler.finish(req, FINISHED):
             # finish() returns False when cancel() won the race after the
             # req.finished guard above — the cancel path already notified
@@ -607,6 +654,18 @@ class InferenceEngine:
         if pre > 0:
             m["preemptions_total"].inc(pre)
         self._preempt_seen = self.scheduler.total_preempted
+        # prefix-cache counters ride the same delta pattern (the manager
+        # owns the source of truth; /metrics gets monotonic counters)
+        for attr, name in (
+            ("prefix_hits_total", "prefix_hits_total"),
+            ("prefix_tokens_saved_total", "prefix_tokens_saved_total"),
+            ("cow_copies_total", "cow_copies_total"),
+        ):
+            cur = getattr(self.blocks, attr)
+            seen = self._prefix_seen.get(attr, 0)
+            if cur > seen:
+                m[name].inc(cur - seen)
+                self._prefix_seen[attr] = cur
         # the remaining gauges cost lock round-trips and a 512-entry sort
         # (_ttft_quantiles) — at hundreds of steps/s that's pure step-loop
         # overhead, so refresh them at 4 Hz (first step always publishes,
@@ -627,6 +686,7 @@ class InferenceEngine:
         s = {
             "scheduler": self.scheduler.stats(),
             "blocks": self.blocks.stats(),
+            "prefix_cache": self.blocks.prefix_stats(),
             "total_steps": self.total_steps,
             "draining": self._draining,
             "compile_count": self.runner.compile_count(),
@@ -635,6 +695,21 @@ class InferenceEngine:
             "ttft": {k: round(v, 6) for k, v in self._ttft_quantiles().items()},
         }
         return s
+
+    def routing_stats(self) -> Dict[str, Any]:
+        """Compact replica load + cache-locality digest, gossiped to
+        routers through the serve controller's long-poll channel
+        (replica -> controller push -> router). Everything here must
+        stay small and picklable — it travels on every routing-set
+        update."""
+        return {
+            "queue_depth": self.scheduler.queue_depth(),
+            "cache_util": round(self.blocks.utilization(), 4),
+            "outstanding_tokens": self.scheduler.outstanding_tokens(),
+            "block_size": self.blocks.block_size,
+            "prefix_digest": self.blocks.prefix_digest(),
+            "draining": self._draining,
+        }
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
         """Block until no queued/running work remains (drain helper)."""

@@ -23,10 +23,14 @@ import numpy as np
 
 from ray_tpu.models.llama import (
     LlamaConfig,
+    copy_paged_blocks,
     init_paged_kv_cache,
     paged_decode_step,
     paged_prefill_step,
 )
+
+#: block-copy pairs per compiled COW program (pairs pad with null->null)
+_COW_WIDTH = 4
 
 
 def _round_up_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -74,13 +78,22 @@ class PagedModelRunner:
         self._decode_jit = jax.jit(
             partial(paged_decode_step, cfg), donate_argnums=donate
         )
+        # COW block duplication (prefix cache): cache is arg 0 here.
+        # partial() gives THIS runner its own jit identity — a bare
+        # module-level function would share one compiled-program cache
+        # across every runner in the process, and another runner's cache
+        # shape would show up in this one's recompile accounting
+        cow_donate = (0,) if jax.default_backend() == "tpu" else ()
+        self._copy_jit = jax.jit(
+            partial(copy_paged_blocks), donate_argnums=cow_donate
+        )
         self._seen_shapes: set = set()
         self._warmup_compiles: Optional[int] = None
 
     # -- compile accounting ----------------------------------------------
     def _jit_cache_entries(self) -> int:
         total = 0
-        for fn in (self._prefill_jit, self._decode_jit):
+        for fn in (self._prefill_jit, self._decode_jit, self._copy_jit):
             size = getattr(fn, "_cache_size", None)
             if size is None:
                 return len(self._seen_shapes)
@@ -120,9 +133,28 @@ class PagedModelRunner:
                 np.ones(b, np.int32),
             )
             self._seen_shapes.add(("d", b))
+        # the COW copy program (all-null pairs write the null block's
+        # trash back onto itself)
+        pad = np.zeros(_COW_WIDTH, np.int32)
+        self.cache = self._copy_jit(self.cache, pad, pad)
+        self._seen_shapes.add(("c", _COW_WIDTH))
         self.mark_warm()
 
     # -- steps ------------------------------------------------------------
+    def copy_blocks(self, pairs: Sequence[Tuple[int, int]]) -> None:
+        """Device-side block duplication (prefix-cache COW): each
+        ``(src, dst)`` pair copies one whole block across every layer.
+        Pairs beyond ``_COW_WIDTH`` run in chunks; short chunks pad with
+        null->null no-op pairs so the compiled shape never varies."""
+        for i in range(0, len(pairs), _COW_WIDTH):
+            chunk = pairs[i : i + _COW_WIDTH]
+            src = np.zeros(_COW_WIDTH, np.int32)
+            dst = np.zeros(_COW_WIDTH, np.int32)
+            for j, (s, d) in enumerate(chunk):
+                src[j], dst[j] = s, d
+            self._seen_shapes.add(("c", _COW_WIDTH))
+            self.cache = self._copy_jit(self.cache, src, dst)
+
     def prefill_chunk(
         self,
         tokens: Sequence[int],

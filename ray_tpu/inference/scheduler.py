@@ -71,6 +71,13 @@ class Request:
     #: tokens, flipping ``prefill_done`` back to False every step and
     #: silently routing decode through ungrown prefill chunks.
     restart_prompt: Optional[List[int]] = None
+    #: device block copies the engine must run BEFORE this request's
+    #: next prefill chunk (prefix-cache COW: a full-prompt hit recomputes
+    #: its last token into a private copy of the final shared block)
+    pending_cow: List[tuple] = field(default_factory=list)
+    #: prompt tokens covered by the prefix cache at (re)admission —
+    #: prefill was skipped for them (observability)
+    cached_prefix_tokens: int = 0
     arrival: int = field(default_factory=lambda: next(_seq))
 
     @property
@@ -177,6 +184,21 @@ class ContinuousBatchingScheduler:
         with self._lock:
             return len(self.waiting)
 
+    def outstanding_tokens(self) -> int:
+        """Token-denominated backlog: prefill still owed plus decode
+        still to run, across queued and running requests — the router's
+        least-outstanding-tokens load signal (a queue-DEPTH count rates
+        a 4-token probe and a 2k-token prompt the same; tokens don't)."""
+        total = 0
+        with self._lock:
+            for req in self.waiting:
+                total += len(req.effective_prompt) + req.max_new_tokens
+            for req in self.running:
+                prompt = req.effective_prompt
+                total += max(0, len(prompt) - req.prefill_pos)
+                total += max(0, req.max_new_tokens - len(req.generated))
+        return total
+
     # -- planning ---------------------------------------------------------
     def _admit(self, reaped: List[Request]) -> None:
         """FIFO admission: pop waiting requests while blocks cover their
@@ -192,12 +214,27 @@ class ContinuousBatchingScheduler:
                 reaped.append(req)
         while self.waiting:
             req = self.waiting[0]
-            need = len(req.effective_prompt) + 1  # headroom: first decode token
+            prompt = req.effective_prompt
+            # prefix cache: attach shared blocks covering the longest
+            # cached prefix; prefill then plans only the uncached tail.
+            # A readmission re-queries too — its own blocks usually
+            # still sit in the cache, making readmission near-free.
+            cached, cow = self.blocks.acquire_prefix(req.request_id, prompt)
+            need = len(prompt) + 1  # headroom: first decode token
             if not self.blocks.grow_to(req.request_id, need):
+                if cached or cow:
+                    # roll the acquisition back: a QUEUED request must
+                    # hold nothing, or pool accounting drifts while it
+                    # waits (the next tick re-acquires — the hit blocks
+                    # just return to the cache LRU meanwhile)
+                    self.blocks.free(req.request_id)
                 break  # FIFO: don't starve the head by admitting behind it
             self.waiting.pop(0)
             req.state = PREFILL
-            req.prefill_pos = 0
+            req.prefill_pos = cached
+            req.pending_cow = list(cow)
+            req.cached_prefix_tokens = cached
+            self.blocks.note_prefix_hit(cached)
             self.running.append(req)
             if req.preemptions == 0:
                 # readmissions after preemption are churn, not intake —
@@ -221,11 +258,14 @@ class ContinuousBatchingScheduler:
         if victim.priority > exclude.priority:
             return False  # never preempt strictly-higher priority work
         self.running.remove(victim)
-        self.blocks.evict(victim.request_id)
+        self.blocks.evict(victim.request_id)  # also drops any COW pins
         victim.state = QUEUED
         victim.prefill_pos = 0
         victim.preemptions += 1
         victim.restart_prompt = victim.prompt + victim.generated
+        # an unexecuted COW died with the eviction: readmission
+        # re-acquires from the cache and plans a fresh copy if needed
+        victim.pending_cow = []
         self.waiting.insert(0, victim)
         self.total_preempted += 1
         return True

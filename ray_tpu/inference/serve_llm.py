@@ -114,6 +114,13 @@ class LLMServer:
     def engine_stats(self) -> Dict[str, Any]:
         return self.engine.stats()
 
+    def routing_stats(self) -> Dict[str, Any]:
+        """Load + prefix-digest gossip consumed by the serve router's
+        cache-affinity scoring. The presence of this method is what opts
+        a deployment's replicas into the gossip reporter
+        (``serve/replica.py``) — plain deployments never pay for it."""
+        return self.engine.routing_stats()
+
     def metrics_address(self) -> Optional[str]:
         if self._metrics_server is None:
             return None
@@ -146,14 +153,22 @@ def llm_deployment(
     route_prefix: Optional[str] = "/llm",
     seed: int = 0,
     autoscaling_config=None,
+    version: Optional[str] = None,
 ):
     """Build a Serve deployment serving ``model_cfg`` through a
     continuous-batching engine (the ``serve.llm`` entry point).
 
     ``serve.run(llm_deployment(cfg).bind())`` → DeploymentHandle whose
     ``stream(request, _method="generate")`` yields tokens and whose
-    ``remote(request)`` returns the whole generation.
-    """
+    ``remote(request)`` returns the whole generation. ``num_replicas``
+    scales out: each replica hosts its own engine (same ``seed`` → same
+    params → identical generations), the router scores replicas by
+    outstanding tokens blended with prefix-cache affinity, and
+    ``autoscaling_config`` reacts to serve ongoing counts PLUS the
+    engines' gossiped admission-queue depth. Pin ``version`` to make a
+    num_replicas redeploy an in-place scale instead of a rolling
+    replacement (model code rarely changes between scale events; a
+    fresh replica warmup per scale step would)."""
     from ray_tpu import serve
 
     dep = serve.deployment(
@@ -163,6 +178,7 @@ def llm_deployment(
         ray_actor_options=ray_actor_options,
         route_prefix=route_prefix,
         autoscaling_config=autoscaling_config,
+        version=version,
     )(LLMServer)
 
     class _BoundDeployment:

@@ -371,6 +371,18 @@ def init_paged_kv_cache(
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def copy_paged_blocks(cache, src, dst):
+    """Duplicate whole cache blocks device-side (prefix-cache COW):
+    ``src``/``dst`` are [P] int32 block ids; every layer's K/V rows at
+    ``dst`` become copies of ``src``. Padding pairs point both ids at
+    the null block (0) — writing the null block's own trash back onto
+    itself keeps the shape static and the content inert."""
+    return {
+        "k": cache["k"].at[:, dst].set(cache["k"][:, src]),
+        "v": cache["v"].at[:, dst].set(cache["v"][:, src]),
+    }
+
+
 def _rope_at(cfg: LlamaConfig, positions):
     """cos/sin tables at arbitrary int positions: [N] -> ([N, hd/2] x2)."""
     hd = cfg.head_dim

@@ -42,3 +42,25 @@ CONTROLLER_RECONNECTS = Counter(
     "controller connection re-establishments (re-register/re-subscribe)",
     ("role",),
 )
+
+# -- serve router decisions (serve/router.py) -------------------------------
+# Routing policy behavior must be observable per process that routes
+# (drivers, proxies, replicas calling other deployments): which policy
+# actually fired, and how often cache affinity found a warm replica.
+
+#: replica choices by policy (affinity = scored cache-affinity +
+#: least-outstanding-tokens; pow2 = the stale-signal/plain fallback;
+#: single = only one candidate)
+ROUTER_DECISIONS = Counter(
+    "raytpu_router_decisions_total",
+    "serve router replica choices, by deployment and policy",
+    ("deployment", "policy"),
+)
+
+#: scored choices whose winner already held cached prefix blocks for
+#: the request — every hit is prefill work the cluster skipped
+ROUTER_AFFINITY_HITS = Counter(
+    "raytpu_router_affinity_hits_total",
+    "scored routing decisions that landed on a prefix-warm replica",
+    ("deployment",),
+)

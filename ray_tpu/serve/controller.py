@@ -19,6 +19,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.replica import Replica
 
@@ -53,6 +54,10 @@ class _DeploymentState:
         #: replica uid -> multiplexed model ids loaded there (pushed by
         #: replicas; propagated to routers through the long-poll)
         self.replica_models: Dict[str, List[str]] = {}
+        #: replica uid -> (routing stats dict, receipt monotonic) —
+        #: load + prefix-digest gossip from gossip-capable replicas
+        #: (serve/replica.py), shipped to routers with the routing set
+        self.replica_stats: Dict[str, Tuple[Dict[str, Any], float]] = {}
 
 
 class _ServeController:
@@ -153,16 +158,55 @@ class _ServeController:
             state = self._deployments.get(name)
             return [r for _v, r in state.replicas] if state else []
 
-    def _routing_set(self, name: str) -> List[Tuple[Any, List[str]]]:
-        """(handle, loaded_model_ids) pairs — what routers consume."""
+    def _routing_set(self, name: str):
+        """(handle, loaded_model_ids, stats_entry) triples — what
+        routers consume. ``stats_entry`` is None for replicas that never
+        gossiped (plain deployments), else ``{"stats": ..., "age_s": ...}``
+        with the age measured on THIS controller's clock at poll time
+        (routers age it locally from receipt — monotonic clocks don't
+        compare across processes)."""
+        now = time.monotonic()
         with self._lock:
             state = self._deployments.get(name)
             if state is None:
                 return []
-            return [
-                (r, state.replica_models.get(r.actor_id.hex(), []))
-                for _v, r in state.replicas
-            ]
+            out = []
+            for _v, r in state.replicas:
+                uid = r.actor_id.hex()
+                ent = state.replica_stats.get(uid)
+                stats_entry = (
+                    {
+                        "stats": ent[0],
+                        "age_s": max(0.0, now - ent[1]),
+                        # opaque identity of THIS report (controller
+                        # receipt time): routers must reset their
+                        # optimistic load bumps only when a genuinely
+                        # NEW report arrives — re-deriving freshness
+                        # from now-age_s wobbles with delivery latency
+                        # and would wipe bumps on every relay
+                        "stamp": ent[1],
+                    }
+                    if ent is not None
+                    else None
+                )
+                out.append((r, state.replica_models.get(uid, []), stats_entry))
+            return out
+
+    @staticmethod
+    def _live_uids(state: _DeploymentState) -> set:
+        """Actor uids the deployment still tracks in ANY lifecycle list
+        — the pruning horizon for replica-pushed side tables (models,
+        routing stats). One definition, used by every prune site, so a
+        future lifecycle list can't silently leak one of the dicts."""
+        return {
+            r.actor_id.hex()
+            for group in (
+                state.replicas,
+                [(v, h) for v, h, _t in state.starting],
+                [(v, h) for v, h, _t in state.draining],
+            )
+            for _v, r in group
+        }
 
     def report_models(self, name: str, replica_uid: str, models: List[str]) -> bool:
         """Replica-pushed multiplexed-model set (reference: model ids
@@ -175,18 +219,30 @@ class _ServeController:
             state.replica_models[replica_uid] = list(models)
             # prune entries for replicas no longer tracked — without this
             # the dict grows one entry per replica generation forever
-            live = {
-                r.actor_id.hex()
-                for group in (
-                    state.replicas,
-                    [(v, h) for v, h, _t in state.starting],
-                    [(v, h) for v, h, _t in state.draining],
-                )
-                for _v, r in group
-            }
+            live = self._live_uids(state)
             live.add(replica_uid)
             for uid in [u for u in state.replica_models if u not in live]:
                 del state.replica_models[uid]
+        self._bump(name)
+        return True
+
+    def report_replica_stats(self, name: str, replica_uid: str, stats: Dict[str, Any]) -> bool:
+        """Replica-pushed routing gossip (load + prefix digest): stored
+        with a receipt timestamp and broadcast to routers through the
+        same long-poll channel as the routing set. Bounded: entries are
+        pruned to live replicas, mirroring ``report_models``. Bump cost:
+        one long-poll wake per report per parked router — the gossip
+        cadence IS the `serve_replica_stats_period_s` knob (raise it to
+        trade routing-signal freshness for controller fan-out)."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return False
+            state.replica_stats[replica_uid] = (dict(stats), time.monotonic())
+            live = self._live_uids(state)
+            live.add(replica_uid)
+            for uid in [u for u in state.replica_stats if u not in live]:
+                del state.replica_stats[uid]
         self._bump(name)
         return True
 
@@ -566,6 +622,19 @@ class _ServeController:
                     n += 1
                 except Exception:
                     pass
+                # gossip-capable replicas (LLM engines) also report their
+                # ADMISSION-QUEUE depth: requests the engine had to park
+                # for KV blocks are real unmet demand that the serve-level
+                # ongoing count (streams in flight) underplays — fold it
+                # into the autoscale signal so a saturated engine scales
+                # out before callers hit the queue bound. FRESH reports
+                # only: a wedged reporter's last gossip must not pin
+                # phantom demand into every future autoscale pass.
+                ent = st.replica_stats.get(r.actor_id.hex())
+                if ent is not None and (
+                    now - ent[1] < GLOBAL_CONFIG.serve_routing_stats_ttl_s
+                ):
+                    total += float(ent[0].get("queue_depth", 0) or 0)
             if n == 0:
                 continue
             desired = max(

@@ -12,9 +12,11 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import threading
 from typing import Any
 
 import ray_tpu
+from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.serve.multiplex import _model_id_ctx, loaded_model_ids
 
 
@@ -32,6 +34,46 @@ class _Replica:
         self._deployment = deployment
         self._controller_namespace = controller_namespace
         self._reported_models: list = []
+        # routing-stats gossip (cache-affinity routing): a callable that
+        # exposes routing_stats() gets a reporter thread pushing load +
+        # prefix digest to the controller on a timer — request-driven
+        # reporting alone would leave an IDLE replica invisible to the
+        # scored router (fresh stats are the fallback gate), so a cold
+        # scale-up replica would never attract traffic
+        self._stats_stop = threading.Event()
+        if (
+            deployment
+            and hasattr(self._callable, "routing_stats")
+            and GLOBAL_CONFIG.serve_replica_stats_period_s > 0
+        ):
+            threading.Thread(
+                target=self._stats_report_loop,
+                daemon=True,
+                name=f"replica-stats-{deployment}",
+            ).start()
+
+    def _stats_report_loop(self) -> None:
+        period = GLOBAL_CONFIG.serve_replica_stats_period_s
+        controller = None
+        me = ""
+        while not self._stats_stop.wait(period):
+            try:
+                if controller is None:
+                    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+                    me = ray_tpu.get_runtime_context().get_actor_id() or ""
+                    controller = ray_tpu.get_actor(
+                        CONTROLLER_NAME, namespace=self._controller_namespace
+                    )
+                stats = dict(self._callable.routing_stats())
+                stats["ongoing"] = self._ongoing
+                controller.report_replica_stats.remote(
+                    self._deployment, me, stats
+                )
+            except Exception:
+                # controller briefly unreachable (failover, startup
+                # race): drop this tick, keep the loop alive
+                controller = None
 
     def _resolve(self, method: str):
         if method == "__call__":

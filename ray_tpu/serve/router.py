@@ -1,4 +1,4 @@
-"""Router: pow-2 replica choice over a PUSH-updated replica set.
+"""Router: cache-affinity + load-scored replica choice, pow-2 fallback.
 
 Reference: ``serve/_private/replica_scheduler/pow_2_scheduler.py:52`` —
 sample two replicas, compare queue lengths, send to the shorter — fed by
@@ -6,6 +6,19 @@ sample two replicas, compare queue lengths, send to the shorter — fed by
 (a background thread parks in ``poll_replicas`` and wakes the moment
 the routing set changes), not a periodic poll. Deploys/scale-ups/
 replica deaths propagate to routers in milliseconds.
+
+LLM-aware routing (the multi-replica serving tentpole): replicas that
+gossip routing stats (load in OUTSTANDING TOKENS + a compact digest of
+their prefix cache, pushed replica -> controller -> long-poll) are
+scored instead of sampled: ``score = outstanding_tokens + local_bump -
+affinity_weight * matched_prefix_tokens``, lowest wins. A conversation
+whose system prompt is warm on replica A costs A nothing to prefill, so
+A wins until its queue outweighs the cache benefit — locality-aware
+scheduling exactly as the Ray paper frames it, with the blend weight as
+the knob. The scored path engages ONLY when every candidate has fresh
+gossip (``serve_routing_stats_ttl_s``); stale or absent signals fall
+back to pow-2 over cached queue lengths — a wrong load guess
+self-corrects, a stale digest would keep dogpiling one replica.
 
 Execution semantics (reference ``router.py``): ``execute``/
 ``execute_stream`` are retry-until-executed — a dispatch that races a
@@ -24,13 +37,38 @@ import random
 import threading
 import time
 import weakref
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.deadline import Deadline, effective_timeout
 from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
 
 _STATS_TTL_S = 0.25
+
+
+def _count_decision(deployment: str, policy: str, affinity_hit: bool = False) -> None:
+    from ray_tpu.observability.rpc_metrics import (
+        ROUTER_AFFINITY_HITS,
+        ROUTER_DECISIONS,
+    )
+
+    ROUTER_DECISIONS.inc(labels={"deployment": deployment, "policy": policy})
+    if affinity_hit:
+        ROUTER_AFFINITY_HITS.inc(labels={"deployment": deployment})
+
+
+def _request_prompt(args) -> Optional[List[int]]:
+    """Token prompt of an LLM-shaped request payload (the affinity
+    scorer's input), or None for anything else."""
+    if not args:
+        return None
+    req = args[0]
+    if isinstance(req, dict):
+        prompt = req.get("prompt")
+        if isinstance(prompt, (list, tuple)) and prompt:
+            return list(prompt)
+    return None
 
 
 def _poll_loop(router_ref: "weakref.ref", controller, deployment: str) -> None:
@@ -73,6 +111,17 @@ class Router:
         self._stats: dict = {}
         # replica actor_id -> loaded model ids (controller-pushed)
         self._models: dict = {}
+        # replica actor_id -> (received_at_local, routing stats dict,
+        # digest set, report stamp) — controller-relayed gossip for
+        # scored routing; aged on OUR monotonic clock (controller ships
+        # age_s at poll time, clocks don't compare across processes);
+        # the stamp identifies the underlying REPORT so re-relays of an
+        # unchanged one are recognizable
+        self._rstats: Dict[Any, tuple] = {}
+        # replica actor_id -> optimistic token bump: requests dispatched
+        # since that replica's last gossip (cleared by fresher gossip) so
+        # a burst inside one gossip period spreads instead of dogpiling
+        self._local_tokens: Dict[Any, float] = {}
         self._poller_started = False
         self._poller_lock = threading.Lock()
         self._closed = False
@@ -97,18 +146,44 @@ class Router:
             ).start()
 
     def _apply(self, routing_set: List[Any]) -> None:
-        """routing_set: [(handle, loaded_model_ids)] pairs from the
-        controller's long-poll (model ids drive model-local routing)."""
-        replicas, models = [], {}
+        """routing_set entries from the controller's long-poll:
+        ``(handle, loaded_model_ids)`` pairs (legacy) or ``(handle,
+        loaded_model_ids, stats_entry)`` triples, where ``stats_entry``
+        is None or ``{"stats": <routing gossip>, "age_s": <age at poll
+        time>}`` for gossip-capable (LLM) replicas."""
+        now = time.monotonic()
+        replicas, models, rstats = [], {}, {}
         for entry in routing_set:
-            handle, mids = entry
+            handle, mids = entry[0], entry[1]
             replicas.append(handle)
             models[handle.actor_id] = tuple(mids)
+            stats_entry = entry[2] if len(entry) > 2 else None
+            if stats_entry is not None:
+                stats = stats_entry["stats"]
+                received = now - float(stats_entry.get("age_s", 0.0))
+                digest = frozenset(stats.get("prefix_digest") or ())
+                stamp = stats_entry.get("stamp")
+                rstats[handle.actor_id] = (received, stats, digest, stamp)
         with self._replicas_lock:
             self._replicas = replicas
             self._models = models
             live = set(models)
             self._stats = {k: v for k, v in self._stats.items() if k in live}
+            for aid, ent in rstats.items():
+                prev = self._rstats.get(aid)
+                self._rstats[aid] = ent
+                if prev is None or ent[3] != prev[3]:
+                    # a genuinely NEW report already reflects what we
+                    # dispatched — drop the optimistic bump. Comparing
+                    # the report STAMP, not reconstructed receipt times:
+                    # every routing-set relay recomputes received as
+                    # now-age_s, so delivery jitter alone would look
+                    # "fresher" and wipe bumps mid-burst.
+                    self._local_tokens.pop(aid, None)
+            self._rstats = {k: v for k, v in self._rstats.items() if k in live}
+            self._local_tokens = {
+                k: v for k, v in self._local_tokens.items() if k in live
+            }
         if replicas:
             self._have_replicas.set()
         else:
@@ -124,18 +199,21 @@ class Router:
             ]
             self._stats.pop(replica.actor_id, None)
             self._models.pop(replica.actor_id, None)
+            self._rstats.pop(replica.actor_id, None)
+            self._local_tokens.pop(replica.actor_id, None)
             if not self._replicas:
                 self._have_replicas.clear()
 
     # -- choice ----------------------------------------------------------
-    def choose_replica(self, model_id: str = ""):
+    def choose_replica(self, model_id: str = "", request_args=None):
         self._ensure_poller()
         if not self._have_replicas.wait(timeout=30):
             raise RuntimeError(f"no replicas for deployment {self._deployment!r}")
         with self._replicas_lock:
             replicas = list(self._replicas)
         if not replicas:
-            return self.choose_replica(model_id)  # raced a scale-to-zero push
+            # raced a scale-to-zero push
+            return self.choose_replica(model_id, request_args)
         if model_id:
             # model-aware: prefer replicas the controller says already
             # hold the model (replica-pushed, so no stats-TTL staleness)
@@ -146,10 +224,79 @@ class Router:
             if with_model:
                 replicas = with_model
         if len(replicas) == 1:
+            _count_decision(self._deployment, "single")
             return replicas[0]
+        chosen = self._choose_scored(replicas, request_args)
+        if chosen is not None:
+            return chosen
         a, b = random.sample(replicas, 2)
         qa, qb = self._queue_len(a), self._queue_len(b)
+        _count_decision(self._deployment, "pow2")
         return a if qa <= qb else b
+
+    def _choose_scored(self, replicas, request_args):
+        """Least-outstanding-tokens blended with prefix affinity, over
+        replica-gossiped stats. Returns None (→ pow-2 fallback) unless
+        EVERY candidate has gossip fresher than the staleness TTL — a
+        replica without fresh signals scored at an assumed load would
+        either starve (assumed busy) or drown (assumed idle)."""
+        now = time.monotonic()
+        ttl = GLOBAL_CONFIG.serve_routing_stats_ttl_s
+        entries = []
+        with self._replicas_lock:
+            for r in replicas:
+                ent = self._rstats.get(r.actor_id)
+                if ent is None or now - ent[0] > ttl:
+                    return None  # absent/stale signal: fall back
+                entries.append((r, ent[1], ent[2]))
+            bumps = dict(self._local_tokens)
+        prompt = _request_prompt(request_args)
+        prompt_hashes: List[int] = []
+        block_size = 0
+        if prompt is not None:
+            block_size = int(entries[0][1].get("block_size") or 0)
+            if block_size > 0 and len(prompt) >= block_size:
+                from ray_tpu.inference.kv_cache import prefix_block_hashes
+
+                prompt_hashes = prefix_block_hashes(prompt, block_size)
+        weight = GLOBAL_CONFIG.serve_affinity_weight
+        best = None
+        best_key = None
+        best_matched = 0
+        for r, stats, digest in entries:
+            if stats.get("draining"):
+                continue
+            matched = 0
+            if prompt_hashes and digest:
+                # consecutive-prefix match: a hit on block k only helps
+                # if blocks 0..k-1 are warm too (the engine acquires the
+                # LONGEST cached prefix, nothing past the first miss)
+                for h in prompt_hashes:
+                    if h not in digest:
+                        break
+                    matched += 1
+            matched_tokens = matched * block_size
+            load = float(stats.get("outstanding_tokens", 0.0)) + bumps.get(
+                r.actor_id, 0.0
+            )
+            key = (load - weight * matched_tokens, load)
+            if best_key is None or key < best_key:
+                best, best_key, best_matched = r, key, matched_tokens
+        if best is None:
+            return None  # every gossiping replica is draining
+        # optimistic local debit: what this dispatch will add to the
+        # winner's backlog before its next gossip lands
+        est = 64.0
+        if prompt is not None:
+            est = max(1.0, len(prompt) - best_matched) + 64.0
+        with self._replicas_lock:
+            self._local_tokens[best.actor_id] = (
+                self._local_tokens.get(best.actor_id, 0.0) + est
+            )
+        _count_decision(
+            self._deployment, "affinity", affinity_hit=best_matched > 0
+        )
+        return best
 
     def _queue_len(self, replica) -> float:
         now = time.monotonic()
@@ -176,7 +323,7 @@ class Router:
     # -- dispatch ---------------------------------------------------------
     def dispatch(self, method: str, args, kwargs, model_id: str = ""):
         """At-most-once: returns the replica call's ObjectRef."""
-        replica = self.choose_replica(model_id)
+        replica = self.choose_replica(model_id, args)
         self._bump(replica)
         return replica.handle_request.remote(
             method, list(args), dict(kwargs or {}), model_id
@@ -184,7 +331,7 @@ class Router:
 
     def dispatch_stream(self, method: str, args, kwargs, model_id: str = ""):
         """Streaming call: returns the replica generator's ref iterator."""
-        replica = self.choose_replica(model_id)
+        replica = self.choose_replica(model_id, args)
         self._bump(replica)
         return replica.handle_request_streaming.options(
             num_returns="streaming"
@@ -231,7 +378,7 @@ class Router:
         deadline = Deadline.after(budget if budget is not None else 3600)
         last_err: Optional[Exception] = None
         while not deadline.expired:
-            replica = self.choose_replica(model_id)
+            replica = self.choose_replica(model_id, args)
             self._bump(replica)
             try:
                 ref = replica.handle_request.remote(
@@ -282,7 +429,7 @@ class Router:
         item_timeout = budget
         last_err: Optional[Exception] = None
         while not deadline.expired:
-            replica = self.choose_replica(model_id)
+            replica = self.choose_replica(model_id, args)
             self._bump(replica)
             gen = replica.handle_request_streaming.options(
                 num_returns="streaming"

@@ -215,7 +215,8 @@ def test_engine_concurrent_streams_match_dense_zero_recompiles(cfg, params, engi
     # fixed-shape buckets: warmup compiled one program per bucket and
     # serving added NOTHING
     assert engine.runner.recompiles_after_warmup() == 0
-    assert engine.runner.compile_count() == 2 + 4  # prefill + decode buckets
+    # prefill + decode buckets + the COW block-copy program
+    assert engine.runner.compile_count() == 2 + 4 + 1
     # all blocks returned
     assert engine.blocks.used_blocks == 0
 
@@ -433,3 +434,111 @@ def test_abandoned_finished_stream_is_reaped(cfg, params):
             next(eng.tokens(rid))
     finally:
         eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# prefix caching (ISSUE 7): radix reuse, COW, refcount accounting
+
+
+def test_prefix_cache_manager_hit_lru_and_refcounts():
+    """Host-side radix-index mechanics: full blocks registered, hit,
+    shared refcounted, revived off the LRU, and reclaimed under pool
+    pressure — no jax involved."""
+    mgr = PagedBlockManager(8, 4, prefix_cache_enabled=True)  # 7 usable
+    toks = list(range(10, 22))  # 12 tokens = 3 full blocks
+    assert mgr.grow_to("a", 12)
+    assert mgr.register_prefix("a", toks) == 3
+    assert mgr.free("a") == 3
+    # unreferenced cached blocks count as FREE capacity (reclaimable),
+    # but stay indexed until pressure needs them
+    assert mgr.used_blocks == 0 and mgr.cached_blocks == 3
+    # partial-prefix hit: 2 of 3 blocks match, third diverges
+    cached, cow = mgr.acquire_prefix("b", toks[:8] + [99, 98, 97, 96])
+    assert cached == 8 and cow == []
+    shared = mgr.owned("b")
+    assert len(shared) == 2 and all(mgr.refcount(x) == 1 for x in shared)
+    assert mgr.grow_to("b", 13)  # tail blocks from free/LRU
+    # pool pressure reclaims the remaining unreferenced cached block
+    # (b holds 4: 2 shared + 2 private; c's 3 drain free list + LRU)
+    assert mgr.grow_to("c", 4 * (7 - 3 - 1))
+    assert mgr.free_blocks == 0
+    stats = mgr.prefix_stats()
+    assert stats["indexed_blocks"] < 3  # LRU eviction dropped index entries
+    mgr.free("b")
+    mgr.free("c")
+    assert mgr.used_blocks == 0
+
+
+def test_prefix_cache_cow_under_preemption_accounting():
+    """COW + sharer eviction accounting: evicting one sharer leaves the
+    other's blocks intact (refcount decrement, not a free), readmission
+    re-acquires from the cache, and after everything finishes the free /
+    cached / refcount books balance exactly."""
+    mgr = PagedBlockManager(8, 4, prefix_cache_enabled=True)  # 7 usable
+    p = list(range(30, 38))  # 8 tokens = 2 full blocks
+    # A: admit, prefill, register its prompt blocks
+    assert mgr.grow_to("A", 9)  # 3 blocks
+    assert mgr.register_prefix("A", p) == 2
+    a_blocks = mgr.owned("A")
+    # B shares A's prompt blocks (prefix hit) + 1 private tail block
+    cached, cow = mgr.acquire_prefix("B", p + [50, 51])
+    assert cached == 8 and cow == []
+    assert mgr.owned("B")[:2] == a_blocks[:2]
+    assert mgr.grow_to("B", 11)
+    assert [mgr.refcount(x) for x in a_blocks[:2]] == [2, 2]
+    used_with_sharing = mgr.used_blocks
+    assert used_with_sharing == 4  # 3 (A) + 1 private tail (B)
+    # evict the sharer (preemption): shared blocks survive for A,
+    # B's private tail returns to the pool
+    assert mgr.evict("B") == 3
+    assert mgr.total_evictions == 1
+    assert [mgr.refcount(x) for x in a_blocks[:2]] == [1, 1]
+    assert mgr.used_blocks == 3 and mgr.owned("A") == a_blocks
+    # readmission hits the cache again — near-free re-prefill
+    cached, _ = mgr.acquire_prefix("B", p + [50, 51])
+    assert cached == 8
+    assert mgr.grow_to("B", 11)
+    # finish both: refcounts drain to zero, registered blocks park on
+    # the LRU (still free capacity), private blocks go straight back
+    mgr.free("B")
+    mgr.free("A")
+    assert mgr.used_blocks == 0
+    assert mgr.free_blocks == 7
+    assert mgr.cached_blocks == 2
+    assert all(mgr.refcount(x) == 0 for x in range(1, 8))
+    # full-prompt hit takes the COW path: last shared block duplicated
+    cached, cow = mgr.acquire_prefix("C", p)
+    assert cached == len(p) - 1  # one token recomputes into the copy
+    assert len(cow) == 1
+    src, dst = cow[0]
+    assert mgr.owned("C")[-1] == dst and mgr.refcount(src) == 1  # pinned
+    mgr.cow_copied("C")
+    assert mgr.refcount(src) == 0  # pin released, back to the cache
+    assert mgr.cow_copies_total == 1
+    mgr.free("C")
+    assert mgr.used_blocks == 0 and mgr.free_blocks == 7
+
+
+def test_engine_shared_prefix_matches_dense_with_zero_recompiles(cfg, params, engine):
+    """Two requests sharing a system prompt: the second's prefill skips
+    the cached blocks yet streams IDENTICAL tokens to the uncached dense
+    reference, with zero post-warmup recompiles; an exact full-prompt
+    repeat exercises the COW path and also matches."""
+    ps0 = engine.blocks.prefix_stats()
+    sys_prompt = [91, 17, 53, 28, 64, 39, 75, 46] * 2  # 16 tokens = 2 blocks
+    tails = ([101, 7], [55, 9])
+    outs = [
+        list(engine.generate(sys_prompt + t, max_new_tokens=6)) for t in tails
+    ]
+    for t, out in zip(tails, outs):
+        assert out == _dense_greedy(cfg, params, sys_prompt + t, 6)
+    # exact repeat of a FULL prompt: every block hits -> COW + 1-token tail
+    rep1 = list(engine.generate(sys_prompt, max_new_tokens=6))
+    rep2 = list(engine.generate(sys_prompt, max_new_tokens=6))
+    assert rep1 == rep2 == _dense_greedy(cfg, params, sys_prompt, 6)
+    ps1 = engine.blocks.prefix_stats()
+    assert ps1["hits_total"] - ps0["hits_total"] >= 2  # warm tail + repeat
+    assert ps1["tokens_saved_total"] - ps0["tokens_saved_total"] >= 16 + 15
+    assert ps1["cow_copies_total"] - ps0["cow_copies_total"] >= 1
+    assert engine.runner.recompiles_after_warmup() == 0
+    assert engine.blocks.used_blocks == 0  # every request's refs released

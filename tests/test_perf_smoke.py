@@ -114,3 +114,64 @@ def test_decode_step_throughput_smoke():
         assert rate >= 100, f"decode throughput collapsed: {rate:.0f} tokens/s"
     finally:
         eng.stop()
+
+
+def test_warm_prefix_ttft_and_hit_rate_smoke():
+    """Prefix-cache perf gate (cluster-free): a prompt whose blocks are
+    already cached must reach its first token FASTER than the cold
+    prefill of the same prompt, and the engine must report a nonzero
+    prefix hit rate. Judged on the median-of-3 re-measure pattern
+    (_floored_rate's shape): the healthy path is one cold/warm pair; a
+    suspicious first pair re-measures twice more and the medians decide,
+    so a box-load spike loses to the two clean samples while a real
+    regression (hits not taken, COW recompiling, prefill not skipped)
+    fails all three."""
+    jax = pytest.importorskip("jax")
+    from ray_tpu.inference.engine import EngineConfig, InferenceEngine
+    from ray_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny(max_seq_len=512)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ec = EngineConfig(
+        num_blocks=72, block_size=16, prefill_buckets=(16, 512),
+        decode_buckets=(1,), max_decode_batch=1, max_new_tokens_default=2,
+    )
+    eng = InferenceEngine(cfg, params, ec).start()
+    try:
+        import numpy as np
+
+        rs = np.random.RandomState(11)
+        prompts = [
+            [int(x) for x in rs.randint(1, cfg.vocab_size, size=448)]
+            for _ in range(3)
+        ]
+
+        def ttft(prompt):
+            t0 = time.perf_counter()
+            rid = eng.submit(prompt, max_new_tokens=2)
+            next(eng.tokens(rid, timeout=120))
+            dt = time.perf_counter() - t0
+            eng.cancel(rid)
+            return dt
+
+        def pair(prompt):
+            return ttft(prompt), ttft(prompt)  # cold (populates), warm (hits)
+
+        cold, warm = pair(prompts[0])
+        if warm >= cold:  # suspicious: re-measure, judge the medians
+            colds, warms = [cold], [warm]
+            for p in prompts[1:]:
+                c, w = pair(p)
+                colds.append(c)
+                warms.append(w)
+            cold, warm = sorted(colds)[1], sorted(warms)[1]
+        assert warm < cold, (
+            f"warm-prefix TTFT {warm*1e3:.1f} ms not below cold "
+            f"{cold*1e3:.1f} ms — the prefix cache is not skipping prefill"
+        )
+        ps = eng.blocks.prefix_stats()
+        assert ps["hit_rate"] > 0, ps
+        assert ps["tokens_saved_total"] >= 447, ps  # full-hit minus 1 token
+        assert eng.runner.recompiles_after_warmup() == 0
+    finally:
+        eng.stop()

@@ -560,3 +560,41 @@ def test_rpc_retry_counter_increments(io):
     assert counts == {"x": 1}
     io.run(client.close())
     io.run(server.stop())
+
+
+def test_idempotent_methods_namespaced_per_role(io):
+    """The idempotent classification is per SERVER ROLE: "stats" is a
+    pure read on node daemons, but a same-named MUTATING handler on a
+    different service must still ride the dedup cache — a process-global
+    set would silently skip stamping for it (the PR 5 deferred finding).
+    An untagged client keeps the legacy union behavior."""
+    from ray_tpu.core.rpc import idempotent_methods
+
+    # the classification itself
+    assert "stats" in idempotent_methods("noded")
+    assert "stats" not in idempotent_methods("controller")
+    assert "stats" in idempotent_methods(None)  # legacy union
+    assert "kv_get" in idempotent_methods("controller")
+    assert "kv_get" not in idempotent_methods("worker")
+
+    # wire behavior: a mutating "stats" on a non-noded role dedups its
+    # retries; the same calls from a noded-tagged client re-execute
+    server, port, counts = _counting_server(io, method="stats")
+    with chaos_plan("stats:reply_drop:0.6", seed=77):
+        tagged = RpcClient("127.0.0.1", port, role="controller")
+        for i in range(8):
+            io.run(tagged.call("stats", ("c", i), retries=50))
+        io.run(tagged.close())
+    # every logical call executed exactly once despite dropped replies
+    assert counts == {("c", i): 1 for i in range(8)}, counts
+
+    # negative control: the noded classification treats "stats" as a
+    # pure read -> no request-id meta -> a retried reply_drop re-executes
+    counts.clear()
+    with chaos_plan("stats:reply_drop:0.6", seed=78):
+        noded = RpcClient("127.0.0.1", port, role="noded")
+        for i in range(8):
+            io.run(noded.call("stats", ("n", i), retries=50))
+        io.run(noded.close())
+    assert sum(counts.values()) > 8, counts  # at least one re-execution
+    io.run(server.stop())

@@ -80,7 +80,8 @@ def test_concurrent_streaming_with_continuous_batching(llm_handle):
     assert sched["steps_with_prefill_and_decode"] > 0, sched
     # fixed-shape buckets: zero recompiles beyond the bucket programs
     assert stats["recompiles_after_warmup"] == 0
-    assert stats["compile_count"] == 3 + 4  # prefill + decode buckets
+    # prefill + decode buckets + the COW block-copy program
+    assert stats["compile_count"] == 3 + 4 + 1
     # all KV blocks returned after the burst
     assert stats["blocks"]["used_blocks"] == 0
 
@@ -157,3 +158,131 @@ def test_drain_finishes_in_flight_streams_zero_errors(llm_handle):
     assert stats["draining"] is True
     assert stats["scheduler"]["running"] == 0
     assert stats["blocks"]["used_blocks"] == 0
+
+
+def test_multi_replica_affinity_routing_and_replica_death(llm_handle):
+    """Multi-replica scale-out E2E (ISSUE 7): a 2-replica deployment with
+    cache-affinity routing pins same-prefix streams to the prefix-warm
+    replica; killing the OTHER replica mid-stream leaves every live
+    stream to finish with zero client-visible errors, and the controller
+    replaces the dead replica."""
+    import time
+
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.inference.engine import EngineConfig
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.observability.rpc_metrics import ROUTER_AFFINITY_HITS
+
+    ec = EngineConfig(
+        num_blocks=64, block_size=8, prefill_buckets=(8, 32),
+        decode_buckets=(1, 4), max_decode_batch=4, max_new_tokens_default=8,
+    )
+    dep = serve.llm_deployment(
+        LlamaConfig.tiny(), engine=ec, name="llm2", num_replicas=2,
+        route_prefix="/llm2", ray_actor_options={"num_cpus": 0.25},
+    )
+    handle = serve.run(dep.bind())
+    old_weight = GLOBAL_CONFIG.serve_affinity_weight
+    # pin hard: affinity must beat the optimistic load bumps so every
+    # warm-prefix stream deterministically lands on the warm replica
+    GLOBAL_CONFIG.serve_affinity_weight = 1e6
+    try:
+        ctrl = ray_tpu.get_actor("__serve_controller__")
+        ray_tpu.get(
+            ctrl.wait_status.remote("llm2", min_replicas=2, timeout_s=60),
+            timeout=90,
+        )
+        prompt = [11, 3, 7, 5, 2, 9, 8, 6] * 3  # 24 tokens = 3 full blocks
+        warm = list(handle.stream(
+            {"prompt": prompt + [42], "max_new_tokens": 4},
+            _method="generate", _timeout=120,
+        ))
+        assert len(warm) == 4
+        # let both replicas' gossip (incl. the fresh prefix digest) reach
+        # the router so the scored path engages for every stream below
+        deadline = time.monotonic() + 20
+        warm_replica = cold_replica = None
+        while time.monotonic() < deadline:
+            replicas = ray_tpu.get(ctrl.get_replicas.remote("llm2"), timeout=30)
+            stats = [
+                ray_tpu.get(
+                    r.handle_request.remote("engine_stats", [], {}, ""),
+                    timeout=60,
+                )
+                for r in replicas
+            ]
+            hot = [
+                r for r, s in zip(replicas, stats)
+                if s["scheduler"]["total_admitted"] > 0
+            ]
+            cold = [
+                r for r, s in zip(replicas, stats)
+                if s["scheduler"]["total_admitted"] == 0
+            ]
+            if len(replicas) == 2 and len(hot) == 1 and len(cold) == 1:
+                warm_replica, cold_replica = hot[0], cold[0]
+                break
+            time.sleep(0.25)
+        assert warm_replica is not None, "could not identify the warm replica"
+        time.sleep(3 * GLOBAL_CONFIG.serve_replica_stats_period_s)
+
+        hits_before = ROUTER_AFFINITY_HITS._values.get(("llm2",), 0.0)
+        n = 4
+        results, errors = {}, {}
+        started = threading.Barrier(n + 1, timeout=60)
+
+        def consume(i):
+            try:
+                gen = handle.stream(
+                    {"prompt": prompt + [60 + i], "max_new_tokens": 30},
+                    _method="generate", _timeout=120,
+                )
+                it = iter(gen)
+                first = next(it)
+                started.wait()  # all streams live -> main thread kills
+                results[i] = [first] + list(it)
+            except Exception as e:  # noqa: BLE001
+                errors[i] = e
+                try:
+                    started.wait()
+                except Exception:
+                    pass
+
+        threads = [threading.Thread(target=consume, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        started.wait()  # every stream produced >= 1 token
+        # kill the replica the affinity router did NOT pick: live streams
+        # ride the warm replica and must all finish untouched
+        ray_tpu.kill(cold_replica)
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert all(len(v) == 30 for v in results.values()), {
+            k: len(v) for k, v in results.items()
+        }
+        # affinity routing provably engaged (scored decisions with a
+        # prefix-warm winner) and the warm replica actually reused blocks
+        assert ROUTER_AFFINITY_HITS._values.get(("llm2",), 0.0) > hits_before
+        warm_stats = ray_tpu.get(
+            warm_replica.handle_request.remote("engine_stats", [], {}, ""),
+            timeout=60,
+        )
+        assert warm_stats["prefix_cache"]["hits_total"] >= n
+        assert warm_stats["prefix_cache"]["tokens_saved_total"] >= n * 24
+        # the controller replaces the killed replica (start-before-kill
+        # machinery from the drain/failover PRs)
+        st = ray_tpu.get(
+            ctrl.wait_status.remote("llm2", min_replicas=2, timeout_s=90),
+            timeout=120,
+        )
+        assert st["replicas"] == 2, st
+        # and the deployment still answers (fresh replica included)
+        again = list(handle.stream(
+            {"prompt": prompt + [42], "max_new_tokens": 4},
+            _method="generate", _timeout=120,
+        ))
+        assert again == warm
+    finally:
+        GLOBAL_CONFIG.serve_affinity_weight = old_weight
+        serve.delete("llm2")
