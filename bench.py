@@ -185,6 +185,80 @@ def bench_runtime(results: Dict[str, Dict]) -> None:
     ray_tpu.shutdown()
 
 
+def bench_data_plane(results: Dict[str, Dict]) -> None:
+    """Cross-node pull throughput: DETERMINISTIC first-pull timings over
+    fixed object sizes (median of 3 distinct objects per size), measured
+    straight against the destination daemon's ``pull_object`` — the
+    chunked pull-manager path, no task machinery in the loop. Exists to
+    pin down the put_gbps 0.6→14.7 GB/s swing (ROADMAP item 5): put_gbps
+    measures local shm writes, this measures the node-to-node transfer
+    those objects ride on."""
+    import statistics
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.rpc import IoThread, RpcClient
+
+    cluster = Cluster(num_cpus=2)
+    io = None
+    try:
+        cluster.add_node(num_cpus=2)
+        time.sleep(1.0)
+        ray_tpu.init(address=cluster.address)
+        head_daemon = ("127.0.0.1", cluster.head_daemon_port)
+        # the added node's daemon = the one that is not the head's
+        dest = next(
+            (n["host"], n["port"])
+            for n in ray_tpu.nodes()
+            if n["port"] != cluster.head_daemon_port
+        )
+        io = IoThread("bench-pull-io")
+        client = RpcClient(dest[0], dest[1], name="bench-dest", role="noded")
+        for size_mb in (8, 64):
+            size = size_mb * 1024 * 1024
+            samples = []
+            for rep in range(3):
+                # a DISTINCT object per rep: every pull is a genuine
+                # first transfer (no local-hit shortcut)
+                arr = np.full(size, rep, dtype=np.uint8)
+                ref = ray_tpu.put(arr)
+                t0 = time.perf_counter()
+                reply = io.run(
+                    client.call(
+                        "pull_object",
+                        {
+                            "object_id": ref.id().binary(),
+                            "sources": [head_daemon],
+                            "deadline_s": 120.0,
+                        },
+                        timeout=120,
+                    ),
+                    timeout=130,
+                )
+                dt = time.perf_counter() - t0
+                assert reply and reply.get("segment"), reply
+                samples.append(size / dt / 1e9)
+                ray_tpu.free(ref)
+            results[f"pull_gbps_{size_mb}mb"] = {
+                "value": round(statistics.median(samples), 3),
+                "unit": f"GB/s (cross-node pull, {size_mb} MiB, median of 3)",
+            }
+            print(
+                f"  pull_gbps_{size_mb}mb: {results[f'pull_gbps_{size_mb}mb']}",
+                file=sys.stderr, flush=True,
+            )
+        io.run(client.close())
+    finally:
+        if io is not None:
+            io.stop()
+        try:
+            ray_tpu.shutdown()
+        finally:
+            cluster.shutdown()
+
+
 def bench_serve_llm(results: Dict[str, Dict]) -> None:
     """LLM serving engine on the toy config, measured through the FULL
     serve streaming path (router dispatch + streaming generator + engine
@@ -614,6 +688,12 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         results["runtime_error"] = {"error": repr(e)}
         print(f"runtime bench failed: {e!r}", file=sys.stderr, flush=True)
+    print("== data plane (cross-node pull) ==", file=sys.stderr, flush=True)
+    try:
+        bench_data_plane(results)
+    except Exception as e:  # noqa: BLE001
+        results["data_plane_error"] = {"error": repr(e)}
+        print(f"data plane bench failed: {e!r}", file=sys.stderr, flush=True)
     print("== serve LLM benchmarks ==", file=sys.stderr, flush=True)
     try:
         bench_serve_llm(results)
@@ -651,6 +731,8 @@ def main() -> None:
         runtime_ratios["serve_llm_ttft_p50_ms"] = ttft["value"]
         runtime_ratios["serve_llm_ttft_p99_ms"] = ttft.get("p99")
     for key, label in (
+        ("pull_gbps_8mb", "pull_gbps_8mb"),
+        ("pull_gbps_64mb", "pull_gbps_64mb"),
         ("serve_llm_cold_ttft_p50", "serve_llm_cold_ttft_p50_ms"),
         ("serve_llm_warm_ttft_p50_p99", "serve_llm_warm_ttft_p50_ms"),
         ("serve_llm_prefix_hit_rate", "serve_llm_prefix_hit_rate"),

@@ -136,6 +136,17 @@ class Worker:
         self._lock = threading.Lock()
         self.fn_table = FunctionTable(backend.kv_put, backend.kv_get)
         self.tmpl_table = TemplateTable(backend.kv_put)
+        # Pre-warm the None-function export (actor-method specs carry
+        # function_obj=None; its FIRST export does a blocking kv_put).
+        # Without this, the first slow-path actor submit in a process can
+        # be ActorHandle.__del__'s __ray_terminate__ — and cyclic GC can
+        # run that __del__ ON the io-loop thread (any allocation there can
+        # trigger it), where a blocking io.run() deadlocks the driver: the
+        # loop waits on a future only the loop itself could resolve.
+        try:
+            self.fn_table.export(None)
+        except Exception:
+            pass  # backend not reachable yet: __del__'s own guard remains
         set_refcount_hooks(self._on_ref_created, self._on_ref_deleted, self._on_ref_borrowed)
 
     # ---- task context --------------------------------------------------

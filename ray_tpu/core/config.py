@@ -43,6 +43,24 @@ class GlobalConfig:
     # page recycling in StoreClient; 0 disables recycling).
     object_store_recycle_bytes: int = 512 * 1024**2
 
+    # --- pull manager (core/pull_manager.py: daemon↔daemon transfer) ---
+    #: admission budget for concurrent inbound transfers: total bytes of
+    #: objects in flight; further pulls queue FIFO (backpressure instead
+    #: of OOMing the daemon). An object larger than the whole budget is
+    #: still admitted when it is alone. <=0 disables admission control.
+    pull_max_inflight_bytes: int = 256 * 1024**2
+    #: per-chunk fetch timeout — a stalled source costs one chunk
+    #: timeout, not the whole-transfer timeout
+    pull_chunk_timeout_s: float = 15.0
+    #: chunk fetch attempts per source before failing over to the next
+    #: source (the transfer RESUMES from the last verified offset there)
+    pull_chunk_retries: int = 3
+    #: chunk requests kept in flight per transfer (reference: pipelined
+    #: 5 MiB chunks) — serial request/response is latency-bound on
+    #: virtualized hosts; verification and shm writes stay strictly
+    #: sequential regardless. 1 disables pipelining.
+    pull_pipeline_depth: int = 4
+
     # --- scheduling ---
     # Hybrid policy: prefer local node until it exceeds this utilization
     # fraction, then spread over the top-k best nodes (reference
@@ -229,6 +247,15 @@ class GlobalConfig:
     #: RNG seed for the fault plan; 0 = generate one (printed at
     #: activation so any failure reproduces from the log)
     testing_rpc_chaos_seed: int = 0
+    #: seeded DATA-PLANE fault plan consulted by the pull manager once
+    #: per chunk attempt: "mode:prob[:param],..." with mode in
+    #: {chunk_drop, chunk_corrupt, chunk_stall, source_die_mid_transfer}
+    #: — see util/chaos.py::DataFaultPlan (same determinism contract as
+    #: RpcFaultPlan). Empty = no injection.
+    testing_pull_chaos: str = ""
+    #: RNG seed for the pull fault plan; 0 = generate one (logged at
+    #: activation for replay)
+    testing_pull_chaos_seed: int = 0
 
     def reset(self) -> None:
         for f in fields(self):

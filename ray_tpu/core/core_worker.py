@@ -428,8 +428,22 @@ class CoreWorker(RuntimeBackend):
             if deadline is not None and time.monotonic() >= deadline:
                 raise GetTimeoutError(f"get() timed out waiting for {oid.hex()[:12]}")
 
+    @staticmethod
+    def _parse_pull_reply(reply):
+        """Split a ``pull_object`` reply into (meta, failure): success is
+        the ``{"segment", "size"}`` meta; a structured failure carries
+        ``no_source`` + per-source ``causes`` (see core/pull_manager.py).
+        A bare None (legacy daemon) maps to an empty failure."""
+        if reply is None:
+            return None, {"failed": True, "no_source": True, "causes": {}}
+        if isinstance(reply, dict) and reply.get("failed"):
+            return None, reply
+        return reply, None
+
     async def _fetch_from_locations(self, oid: ObjectID, locations, deadline) -> Any:
         """Materialize a shm object locally, then zero-copy deserialize."""
+        from ray_tpu.core.deadline import effective_timeout
+
         if not locations:
             raise ObjectLostError(oid, "no locations")
         local = next((l for l in locations if l[0] == self.node_id), None)
@@ -437,11 +451,27 @@ class CoreWorker(RuntimeBackend):
             meta = await self.daemon.call("get_object_meta", {"object_id": oid.binary()})
         else:
             meta = None
+        failure = None
         if meta is None:
             sources = [(h, p) for (_nid, h, p) in locations if _nid != self.node_id]
-            meta = await self.daemon.call(
-                "pull_object", {"object_id": oid.binary(), "sources": sources}, timeout=300
+            # the pull inherits this get()'s remaining budget (nested gets
+            # propagate deadlines through the whole fetch path — a
+            # hard-coded 300 here used to quietly extend the caller's)
+            budget = effective_timeout(300.0)
+            reply = await self.daemon.call(
+                "pull_object",
+                {"object_id": oid.binary(), "sources": sources, "deadline_s": budget},
+                timeout=budget,
             )
+            meta, failure = self._parse_pull_reply(reply)
+            if meta is None and failure.get("deadline"):
+                # the transfer ran out of THIS caller's budget, with live
+                # sources: that is a timeout, not object loss — lineage
+                # reconstruction / relocation fallback would be wrong
+                raise GetTimeoutError(
+                    f"fetch of {oid.hex()[:12]} ran out of budget mid-transfer "
+                    f"({failure.get('causes')})"
+                )
         if meta is None:
             # Stale locations can mean the holding node DRAINED and
             # replicated its copies away — consult the controller's
@@ -452,7 +482,23 @@ class CoreWorker(RuntimeBackend):
             if moved is not None:
                 meta = moved
         if meta is None:
-            raise ObjectLostError(oid, f"could not fetch from {locations}")
+            # ONE owner-side line for the whole fetch attempt: the
+            # structured causes say which sources were missing the object
+            # vs which transfers failed (the pull manager already logged
+            # its own single summary daemon-side)
+            causes = (failure or {}).get("causes", {})
+            detail = (
+                "no source holds the object"
+                if (failure or {}).get("no_source")
+                else "every transfer failed"
+            )
+            logger.warning(
+                "fetch of %s from %d location(s) failed (%s): %s",
+                oid.hex()[:12], len(locations), detail, causes,
+            )
+            raise ObjectLostError(
+                oid, f"could not fetch from {locations} ({detail}: {causes})"
+            )
         buf = self.shm.read(oid, meta["size"])
         value = serialization.deserialize_bytes(buf)
         if self.refcounter.owns(oid):
@@ -464,6 +510,8 @@ class CoreWorker(RuntimeBackend):
         node replicated this object, pull from there. Returns local shm
         meta or None. Updates the owner's location set so later readers
         skip the detour."""
+        from ray_tpu.core.deadline import effective_timeout
+
         try:
             loc = await self.controller.call(
                 "get_relocated", {"object_id": oid.binary()}, timeout=10
@@ -472,11 +520,17 @@ class CoreWorker(RuntimeBackend):
             return None
         if loc is None:
             return None
-        meta = await self.daemon.call(
+        budget = effective_timeout(300.0)
+        reply = await self.daemon.call(
             "pull_object",
-            {"object_id": oid.binary(), "sources": [(loc["host"], loc["port"])]},
-            timeout=300,
+            {
+                "object_id": oid.binary(),
+                "sources": [(loc["host"], loc["port"])],
+                "deadline_s": budget,
+            },
+            timeout=budget,
         )
+        meta, _failure = self._parse_pull_reply(reply)
         if meta is not None and self.refcounter.owns(oid):
             self.refcounter.add_location(
                 oid, (loc["node_id"], loc["host"], loc["port"])
@@ -1456,9 +1510,51 @@ class CoreWorker(RuntimeBackend):
             logger.exception("actor task %s submission failed", spec.name)
             self._fail_returns(spec, e if isinstance(e, RayTpuError) else RayTpuError(repr(e)))
 
+    async def _recover_push_target(self, actor_id, st, binding) -> bool:
+        """Shared ConnectionLost recovery for actor pushes (ordered-batch
+        AND direct submit paths): consult the controller, refresh the
+        cached actor state, and decide whether the SAME live incarnation
+        can be re-pushed under the bound request id (True — the re-push
+        is dedup-protected, consumes no task-retry budget, and is safe
+        even for streaming calls) or the binding must be invalidated so
+        the caller applies its per-spec retry/fail semantics (False).
+
+        The controller consult is deliberately NOT guarded: if the
+        control plane is also gone there is nothing to wait for — the
+        exception propagates to the caller's catch, which fails the
+        pending returns (a guarded retry here would loop forever on the
+        cached ALIVE state)."""
+        info = await self.controller.call("get_actor_info", {"actor_id": actor_id})
+        with self._actors_lock:
+            if info is not None:
+                st.state = info["state"]
+                st.address = info["address"]
+                st.reason = info.get("reason", "")
+            else:
+                st.state = "DEAD"
+        if (
+            st.state == "ALIVE"
+            and st.address is not None
+            and (st.address.host, st.address.port)
+            == (binding.client.host, binding.client.port)
+            and binding.can_retry_same_target()
+        ):
+            # same live incarnation, connection blip only: this is what
+            # makes non-idempotent serve calls safely auto-retryable
+            # while the replica is reachable (serve/router.py contract)
+            binding.note_retry()
+            await asyncio.sleep(0.1)
+            return True
+        # actor moved/died (or retries exhausted): the next push is a
+        # DIFFERENT logical request — fresh id
+        binding.invalidate()
+        return False
+
     async def _submit_actor_batch(self, batch: List[TaskSpec]) -> None:
         """Push an ordered batch of calls to one actor; retries keep order
         (the whole remaining batch is re-pushed after a restart)."""
+        from ray_tpu.core.transport_retry import PushBinding
+
         actor_id = batch[0].actor_id
         all_specs = list(batch)
         with self._actors_lock:
@@ -1469,9 +1565,7 @@ class CoreWorker(RuntimeBackend):
         # reply was lost after execution is answered from the server's
         # reply cache instead of running twice. A new client (actor moved)
         # or a trimmed batch gets a fresh id — different logical request.
-        push_client = None
-        push_rid: Optional[int] = None
-        transport_retries = 0
+        binding = PushBinding()
         try:
             while batch:
                 try:
@@ -1487,10 +1581,7 @@ class CoreWorker(RuntimeBackend):
                         )
                     return
                 client = self._client(st.address.host, st.address.port, role="worker")
-                if client is not push_client:
-                    push_client = client
-                    push_rid = client.next_request_id()
-                    transport_retries = 0
+                push_rid = binding.bind(client)
                 for s in batch:
                     # streaming methods need the producer's address for
                     # consumer-position (backpressure) reports
@@ -1515,42 +1606,8 @@ class CoreWorker(RuntimeBackend):
                     await asyncio.sleep(0.02)
                     continue
                 except ConnectionLost:
-                    # controller consult is NOT guarded: if the control
-                    # plane is also gone there is nothing to wait for —
-                    # the exception propagates to the pump's catch, which
-                    # fails the batch returns (matches the old per-call
-                    # path; a guarded retry here would loop forever on the
-                    # cached ALIVE state)
-                    info = await self.controller.call(
-                        "get_actor_info", {"actor_id": actor_id}
-                    )
-                    with self._actors_lock:
-                        if info is not None:
-                            st.state = info["state"]
-                            st.address = info["address"]
-                            st.reason = info.get("reason", "")
-                        else:
-                            st.state = "DEAD"
-                    if (
-                        st.state == "ALIVE"
-                        and st.address is not None
-                        and (st.address.host, st.address.port)
-                        == (client.host, client.port)
-                        and transport_retries < GLOBAL_CONFIG.rpc_max_retries
-                    ):
-                        # same live incarnation, connection blip only: the
-                        # re-push is dedup-protected (same request id) —
-                        # retry transparently, consuming NO task retry
-                        # budget and without trimming streaming calls.
-                        # This is what makes non-idempotent serve calls
-                        # safely auto-retryable while the replica is
-                        # reachable (serve/router.py contract).
-                        transport_retries += 1
-                        await asyncio.sleep(0.1)
+                    if await self._recover_push_target(actor_id, st, binding):
                         continue
-                    # actor moved/died (or retries exhausted): the next
-                    # push is a DIFFERENT logical request — fresh id
-                    push_client = None
                     survivors: List[TaskSpec] = []
                     for s in batch:
                         tid = s.task_id.binary()
@@ -1596,15 +1653,15 @@ class CoreWorker(RuntimeBackend):
                 self._inflight_workers.pop(s.task_id.binary(), None)
 
     async def _submit_actor_inner(self, spec: TaskSpec) -> None:
+        from ray_tpu.core.transport_retry import PushBinding
+
         try:
             with self._actors_lock:
                 st = self._actors.setdefault(spec.actor_id, _ActorState())
             retries_left = st.max_task_retries
             # request-id reuse across re-pushes to the same incarnation
             # (see _submit_actor_batch for the exactly-once rationale)
-            push_client = None
-            push_rid: Optional[int] = None
-            transport_retries = 0
+            binding = PushBinding()
             while True:
                 st = await self._resolve_actor(spec.actor_id)
                 if st.state == "DEAD":
@@ -1613,10 +1670,7 @@ class CoreWorker(RuntimeBackend):
                     )
                     return
                 client = self._client(st.address.host, st.address.port, role="worker")
-                if client is not push_client:
-                    push_client = client
-                    push_rid = client.next_request_id()
-                    transport_retries = 0
+                push_rid = binding.bind(client)
                 if spec.num_returns == "streaming":
                     self._inflight_workers[spec.task_id.binary()] = (
                         st.address.host,
@@ -1634,28 +1688,8 @@ class CoreWorker(RuntimeBackend):
                     await asyncio.sleep(0.02)
                     continue
                 except ConnectionLost:
-                    # actor possibly restarting — consult the controller
-                    info = await self.controller.call("get_actor_info", {"actor_id": spec.actor_id})
-                    with self._actors_lock:
-                        if info is not None:
-                            st.state = info["state"]
-                            st.address = info["address"]
-                            st.reason = info.get("reason", "")
-                        else:
-                            st.state = "DEAD"
-                    if (
-                        st.state == "ALIVE"
-                        and st.address is not None
-                        and (st.address.host, st.address.port)
-                        == (client.host, client.port)
-                        and transport_retries < GLOBAL_CONFIG.rpc_max_retries
-                    ):
-                        # same live incarnation: dedup-protected re-push
-                        # (same request id) — no budget, streaming safe
-                        transport_retries += 1
-                        await asyncio.sleep(0.1)
+                    if await self._recover_push_target(spec.actor_id, st, binding):
                         continue
-                    push_client = None
                     if (
                         st.state == "DEAD"
                         or retries_left <= 0

@@ -106,7 +106,20 @@ class FunctionTable:
                 self._by_identity[obj] = function_id
             except TypeError:
                 pass
-        self._kv_put(FUNCTION_KV_PREFIX + function_id, payload)
+        try:
+            self._kv_put(FUNCTION_KV_PREFIX + function_id, payload)
+        except BaseException:
+            # roll back: a cached id whose KV write never landed would
+            # short-circuit every future export of this object while
+            # remote loads fail forever with "function not exported"
+            with self._lock:
+                self._exported.pop(function_id, None)
+                self._cache.pop(function_id, None)
+                try:
+                    del self._by_identity[obj]
+                except (KeyError, TypeError):
+                    pass
+            raise
         return function_id
 
     def load(self, function_id: bytes) -> Any:

@@ -131,6 +131,9 @@ class NodeDaemon:
         # gets concrete chip ids (TPU_VISIBLE_CHIPS isolation).
         self._tpu_chips_free: List[int] = list(range(int(res.get("TPU", 0))))
         self.store = ShmStore()
+        from ray_tpu.core.pull_manager import PullManager
+
+        self.pulls = PullManager(self.store, self._peer)
         self.session_dir = session_dir or f"/tmp/ray_tpu/session_{os.getpid()}"
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self.workers: Dict[str, WorkerProc] = {}  # token -> proc
@@ -288,8 +291,13 @@ class NodeDaemon:
             return
         # primaries only: transfer-received replicas already live on
         # their source node — re-replicating them burns the bounded grace
-        # and pollutes the relocation ring for no added durability
-        entries = [e for e in self.store.list_entries() if e.get("primary", True)]
+        # and pollutes the relocation ring for no added durability.
+        # Unsealed entries are mid-receive and not ours to replicate.
+        entries = [
+            e
+            for e in self.store.list_entries()
+            if e.get("primary", True) and e.get("sealed", True)
+        ]
         if not entries:
             return
         moves: List[Dict[str, Any]] = []
@@ -317,7 +325,7 @@ class NodeDaemon:
                     object_id.hex()[:12], peer.host, peer.port, exc_info=True,
                 )
                 continue
-            if meta is not None:
+            if meta is not None and meta.get("segment"):
                 moves.append(
                     {
                         "object_id": object_id,
@@ -1188,51 +1196,53 @@ class NodeDaemon:
 
     async def d_pull_object(self, payload, conn):
         """Ensure the object is in the local store, pulling chunks from a
-        source node (``PullManager``/``PushManager`` equivalent)."""
+        source node. The heavy lifting — admission control, single-flight
+        coalescing, resumable multi-source transfer, end-to-end integrity
+        — lives in :class:`core.pull_manager.PullManager`. The caller may
+        stamp ``deadline_s`` (its remaining budget) so the manager's
+        retry/backoff loops are capped by the SAME deadline the caller
+        enforces, instead of retrying into a dead wait."""
+        from ray_tpu.core.deadline import deadline_scope
+
         object_id = ObjectID(payload["object_id"])
-        meta = self.store.ensure_local(object_id)
-        if meta is not None:
-            return {"segment": meta[0], "size": meta[1]}
-        for host, port in payload["sources"]:
-            client = self._peer(host, port)
-            try:
-                head = await client.call(
-                    "object_info", {"object_id": object_id.binary()}, timeout=10
-                )
-                if head is None:
-                    continue
-                size = head["size"]
-                chunk = GLOBAL_CONFIG.object_transfer_chunk_bytes
-                buf = bytearray(size)
-                off = 0
-                while off < size:
-                    data = await client.call(
-                        "fetch_chunk",
-                        {"object_id": object_id.binary(), "offset": off, "length": min(chunk, size - off)},
-                        timeout=60,
-                    )
-                    buf[off : off + len(data)] = data
-                    off += len(data)
-                self.store.create_with_data(object_id, memoryview(buf))
-                meta = self.store.ensure_local(object_id)
-                return {"segment": meta[0], "size": meta[1]}
-            except Exception:
-                logger.warning("pull from %s:%s failed", host, port, exc_info=True)
-        return None
+        with deadline_scope(payload.get("deadline_s")):
+            return await self.pulls.pull(object_id, payload["sources"])
+
+    #: above this size the first (uncached) digest computation would risk
+    #: blowing the puller's fixed probe timeout — serve digest=None and
+    #: warm the cache in the background instead (per-chunk crcs still
+    #: protect the transfer; the whole-object gate kicks in once cached)
+    _DIGEST_SYNC_MAX_BYTES = 1 << 30
 
     async def d_object_info(self, payload, conn):
+        """Transfer head: size + whole-object crc32 digest (computed
+        lazily off-loop, cached on the entry) — the end-to-end integrity
+        token the puller verifies before sealing."""
         object_id = ObjectID(payload["object_id"])
         meta = self.store.ensure_local(object_id)
         if meta is None:
             return None
-        return {"size": meta[1]}
+        loop = asyncio.get_event_loop()
+        if meta[1] > self._DIGEST_SYNC_MAX_BYTES:
+            digest = self.store.peek_digest(object_id)
+            if digest is None:
+                loop.run_in_executor(None, self.store.digest_of, object_id)
+        else:
+            digest = await loop.run_in_executor(
+                None, self.store.digest_of, object_id
+            )
+        return {"size": meta[1], "digest": digest}
 
     async def d_fetch_chunk(self, payload, conn):
         object_id = ObjectID(payload["object_id"])
         data = self.store.read_range(object_id, payload["offset"], payload["length"])
         if data is None:
             raise KeyError(f"object {object_id.hex()[:12]} not here")
-        return data
+        # per-chunk crc: the receiver verifies BEFORE the bytes touch its
+        # destination segment (a corrupt chunk is re-fetched, not served)
+        import zlib
+
+        return (data, zlib.crc32(data))
 
     async def d_delete_object(self, payload, conn):
         """Delete an object. ``allow_recycle`` is sent by the deleting

@@ -123,6 +123,15 @@ class _Entry:
     spilled_path: Optional[str] = None
     in_shm: bool = True
     created_at: float = field(default_factory=time.monotonic)
+    # crc32 content digest, computed lazily on first object_info serve
+    # (or recorded at seal time by the pull manager) — the end-to-end
+    # integrity token carried with transfer metadata
+    digest: Optional[int] = None
+    # False when a streaming receive ATTACHED to a pre-existing inode
+    # (simulated multi-node: the "remote" source shares this /dev/shm, so
+    # the segment already exists with identical immutable content) — an
+    # aborted receive must then NOT unlink it out from under the source
+    inode_owner: bool = True
     # True once ANY reader resolved this object through the daemon
     # (get_object_meta / transfer). Gates segment recycling: an inode no
     # process ever attached can be renamed+rewritten by its creator with
@@ -163,7 +172,8 @@ class ShmStore:
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             e = self._entries.get(object_id)
-            return e is not None
+            # unsealed (mid-receive) entries are invisible to readers
+            return e is not None and e.sealed
 
     def list_entries(self) -> List[Dict[str, object]]:
         """State-API view of every tracked object (``ray list objects``)."""
@@ -176,6 +186,7 @@ class ShmStore:
                     "pinned": e.pinned,
                     "spilled": e.spilled_path is not None,
                     "primary": e.primary,
+                    "sealed": e.sealed,
                 }
                 for oid, e in self._entries.items()
             ]
@@ -219,6 +230,114 @@ class ShmStore:
                 pass
             self._entries[object_id] = _Entry(size=size, primary=False)
             self._used += size
+
+    # -- streaming receive (pull manager) --------------------------------
+    # The destination segment is allocated UP FRONT and chunks are
+    # written directly into it (no whole-object heap buffer). The entry
+    # exists unsealed for the duration — invisible to every reader path
+    # (contains/ensure_local/read_*) — and is either sealed atomically
+    # once the content digest verifies, or aborted without a trace.
+
+    def begin_receive(self, object_id: ObjectID) -> bool:
+        """Reserve an unsealed entry for an incoming transfer. Returns
+        False if the object is already present (sealed) — the pull is a
+        no-op. A stale unsealed entry (aborted transfer that lost the
+        race to clean up) is replaced."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None:
+                if e.sealed:
+                    return False
+                self._abort_receive_locked(object_id, e)
+            return True
+
+    def allocate_receive(self, object_id: ObjectID, size: int) -> str:
+        """Create the destination segment for a begin_receive'd transfer
+        (separate from begin_receive so admission control can run between
+        the reservation and the allocation). Returns the segment name;
+        the caller attaches and writes chunks into it."""
+        with self._lock:
+            self._make_room(size)
+            inode_owner = True
+            try:
+                seg = _create(segment_name(object_id), size)
+                seg.close()
+            except FileExistsError:
+                # simulated multi-node: the source shares this /dev/shm,
+                # the inode already holds the (immutable) content — write
+                # over it with identical bytes, but never unlink it on
+                # abort (the source still serves from it)
+                inode_owner = False
+            self._entries[object_id] = _Entry(
+                size=size, sealed=False, primary=False, inode_owner=inode_owner
+            )
+            self._used += size
+            return segment_name(object_id)
+
+    def seal_receive(self, object_id: ObjectID, digest: Optional[int] = None) -> None:
+        """Atomically publish a fully-received, digest-verified object:
+        only now do readers see it."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                return
+            e.sealed = True
+            e.digest = digest
+
+    def abort_receive(self, object_id: ObjectID) -> None:
+        """Tear down a failed transfer: the uncommitted segment is
+        dropped; readers never saw the entry."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or e.sealed:
+                return  # sealed entries are never aborted
+            self._abort_receive_locked(object_id, e)
+
+    def _abort_receive_locked(self, object_id: ObjectID, e: _Entry) -> None:
+        self._entries.pop(object_id, None)
+        self._used -= e.size
+        if e.inode_owner:
+            try:
+                seg = _attach(segment_name(object_id))
+                seg.unlink()
+                seg.close()
+            except FileNotFoundError:
+                pass
+
+    def peek_digest(self, object_id: ObjectID) -> Optional[int]:
+        """Cached digest only — never computes (cheap probe-path check)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            return None if e is None else e.digest
+
+    def digest_of(self, object_id: ObjectID) -> Optional[int]:
+        """crc32 content digest, computed lazily and cached on the entry
+        (the transfer-metadata integrity token). None if absent."""
+        import zlib
+
+        meta = self.ensure_local(object_id)
+        if meta is None:
+            return None
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                return None
+            if e.digest is not None:
+                return e.digest
+        name, size = meta
+        try:
+            seg = _attach(name)
+        except FileNotFoundError:
+            return None  # raced a spill/delete; caller retries via ensure_local
+        try:
+            digest = zlib.crc32(seg.buf[:size])
+        finally:
+            seg.close()
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None:
+                e.digest = digest
+        return digest
 
     def _recycle_pool_debt(self) -> int:
         """Bytes held by worker segment-reuse pools (``rt-pool-*`` files):
@@ -288,7 +407,9 @@ class ShmStore:
         needed; None if unknown."""
         with self._lock:
             e = self._entries.get(object_id)
-            if e is None:
+            if e is None or not e.sealed:
+                # unsealed = a transfer in flight: readers must never see
+                # a partially-written segment
                 return None
             self._entries.move_to_end(object_id)  # LRU touch
             e.read_by_any = True

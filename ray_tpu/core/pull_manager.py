@@ -1,0 +1,587 @@
+"""Fault-tolerant pull manager: the daemon↔daemon object transfer path.
+
+Reference: ``src/ray/object_manager/pull_manager.h`` — the reference
+treats node-to-node transfer as a first-class fault domain: admission
+control over in-flight pull bytes, chunked pipelining, retry on source
+loss. This module is that subsystem for the shm store:
+
+* **Streaming shm writes** — the destination segment is allocated up
+  front and chunks are written directly into it (no whole-object heap
+  buffer). The store entry stays UNSEALED for the duration: readers
+  (``contains``/``ensure_local``/``read_*``) never see a partial
+  object; a failed transfer aborts the uncommitted segment.
+* **Resumable multi-source transfer** — per-chunk timeout/retry with
+  jittered backoff capped by the ambient ``core/deadline``; when a
+  source dies or drains mid-pull the transfer fails over to the next
+  source and RESUMES from the last verified offset — a lost source
+  costs one chunk, not the object.
+* **End-to-end integrity** — every chunk carries a crc32 computed by
+  the sender and is verified before it touches the destination segment
+  (mismatch → re-fetch); the whole-object digest carried with
+  ``object_info`` is verified before seal. A corrupt or truncated chunk
+  can never be served to a reader.
+* **Admission control + single-flight** — a bounded in-flight-bytes
+  budget (``pull_max_inflight_bytes``) with strict FIFO queueing, so N
+  concurrent pulls backpressure instead of OOMing the daemon; an object
+  larger than the whole budget is admitted when alone. Concurrent pulls
+  of the same object coalesce onto one transfer.
+* **Data-plane chaos** — a seeded fault plan
+  (``testing_pull_chaos``/``_seed``, :class:`util.chaos.DataFaultPlan`)
+  consulted once per chunk attempt, receiver-side, so the whole fault
+  schedule replays from one logged seed. Modes: chunk_drop /
+  chunk_corrupt / chunk_stall / source_die_mid_transfer.
+
+Results are structured: success is ``{"segment", "size"}`` (the shape
+``get_object_meta`` returns); failure is ``{"failed": True,
+"no_source": bool, "causes": {"host:port": reason}}`` so the owner can
+distinguish "no source has it" (consult the relocation directory) from
+"every transfer failed" (lineage reconstruction) — and log it once.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+import zlib
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.deadline import effective_timeout
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ShmStore, _attach
+from ray_tpu.core.rpc import ConnectionLost
+from ray_tpu.core.transport_retry import backoff_sleep
+
+logger = logging.getLogger(__name__)
+
+_Source = Tuple[str, int]
+
+
+# ---------------------------------------------------------------------------
+# seeded data-plane fault plan (same lazy-activation contract as
+# rpc.active_fault_plan: built once per (spec, seed), seed logged so a
+# failure reproduces from the log alone)
+
+_PLAN_LOCK = threading.Lock()
+_PLAN_KEY: Optional[Tuple[str, int]] = None
+_PLAN = None
+
+
+def active_pull_fault_plan():
+    spec = GLOBAL_CONFIG.testing_pull_chaos
+    if not spec:
+        return None
+    global _PLAN_KEY, _PLAN
+    key = (spec, GLOBAL_CONFIG.testing_pull_chaos_seed)
+    if _PLAN_KEY == key:
+        return _PLAN
+    with _PLAN_LOCK:
+        if _PLAN_KEY == key:
+            return _PLAN
+        from ray_tpu.util.chaos import DataFaultPlan
+
+        seed = GLOBAL_CONFIG.testing_pull_chaos_seed or (
+            int.from_bytes(os.urandom(4), "little") | 1
+        )
+        plan = DataFaultPlan(spec, seed)
+        logger.warning(
+            "pull chaos plan ACTIVE: spec=%r seed=%d "
+            "(reproduce: RAY_TPU_testing_pull_chaos=%r "
+            "RAY_TPU_testing_pull_chaos_seed=%d)",
+            spec, seed, spec, seed,
+        )
+        _PLAN, _PLAN_KEY = plan, key
+        return plan
+
+
+def _count_injection(mode: str) -> None:
+    from ray_tpu.observability.rpc_metrics import RPC_CHAOS_INJECTIONS
+
+    RPC_CHAOS_INJECTIONS.inc(labels={"mode": mode})
+
+
+class _SourceFailed(Exception):
+    """The current source is done for (died, drained, lost the object,
+    or exhausted its chunk-retry budget): fail over to the next one.
+    Carries the verified progress (offset, crc) at failure time so the
+    caller RESUMES there — losing a source must cost one chunk, not the
+    transfer."""
+
+    def __init__(self, msg: str, offset: int = 0, crc: int = 0):
+        super().__init__(msg)
+        self.offset = offset
+        self.crc = crc
+
+
+class _ChunkIntegrityError(Exception):
+    """Received chunk failed its crc/length check — re-fetch it."""
+
+
+class _ChaosChunkError(Exception):
+    """Injected chunk_drop fault (retry path, reason='chaos')."""
+
+
+class _PullAbort(Exception):
+    """The whole pull is over (deadline exhausted / every source
+    failed): surface the structured failure. ``deadline=True`` marks
+    budget exhaustion — the owner maps it to a TIMEOUT, not object
+    loss, and coalesced waiters with their own budget re-initiate."""
+
+    def __init__(self, msg: str, deadline: bool = False):
+        super().__init__(msg)
+        self.deadline = deadline
+
+
+def _addr(src: _Source) -> str:
+    return f"{src[0]}:{src[1]}"
+
+
+class PullManager:
+    """One per node daemon. All methods run on the daemon's event loop;
+    the store itself is thread-safe."""
+
+    def __init__(self, store: ShmStore, peer_factory):
+        self.store = store
+        self._peer = peer_factory  # (host, port) -> RpcClient (cached)
+        self._inflight: Dict[ObjectID, asyncio.Future] = {}
+        self._inflight_bytes = 0
+        self._queued_bytes = 0
+        self._admit_q: Deque[Tuple[int, asyncio.Future]] = deque()
+        #: high-water mark of admitted bytes (admission-control tests)
+        self.max_inflight_bytes_observed = 0
+
+    # -- public entry ----------------------------------------------------
+    async def pull(self, object_id: ObjectID, sources) -> Dict[str, object]:
+        from ray_tpu.core.deadline import current_deadline
+
+        while True:
+            meta = self.store.ensure_local(object_id)
+            if meta is not None:
+                return {"segment": meta[0], "size": meta[1]}
+            existing = self._inflight.get(object_id)
+            if existing is None:
+                break
+            # single-flight: ride the in-progress transfer
+            from ray_tpu.observability.rpc_metrics import PULL_COALESCED
+
+            PULL_COALESCED.inc()
+            result = await asyncio.shield(existing)
+            if not (
+                isinstance(result, dict)
+                and result.get("failed")
+                and result.get("deadline")
+            ):
+                return result
+            # the shared transfer died on the INITIATOR's budget, not
+            # ours — if this caller still has budget, run its own pull
+            # (loop: re-check local state / any newer in-flight transfer)
+            ambient = current_deadline()
+            if ambient is not None and ambient.remaining() <= 0:
+                return result
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._inflight[object_id] = fut
+        result = None
+        try:
+            try:
+                result = await self._pull(object_id, sources)
+            except Exception as e:  # noqa: BLE001 — waiters need a result
+                logger.exception("pull of %s crashed", object_id.hex()[:12])
+                result = {
+                    "failed": True,
+                    "no_source": False,
+                    "causes": {"internal": repr(e)},
+                }
+        finally:
+            # resolve waiters even if the runner was CANCELLED (daemon
+            # stopping) — coalesced pulls must never park forever
+            self._inflight.pop(object_id, None)
+            if not fut.done():
+                fut.set_result(
+                    result
+                    if result is not None
+                    else {
+                        "failed": True,
+                        "no_source": False,
+                        "causes": {"internal": "pull cancelled"},
+                    }
+                )
+        return result
+
+    # -- admission control (FIFO, bounded in-flight bytes) ---------------
+    def _set_gauges(self) -> None:
+        from ray_tpu.observability.rpc_metrics import (
+            PULL_INFLIGHT_BYTES,
+            PULL_QUEUED_BYTES,
+        )
+
+        PULL_INFLIGHT_BYTES.set(self._inflight_bytes)
+        PULL_QUEUED_BYTES.set(self._queued_bytes)
+        if self._inflight_bytes > self.max_inflight_bytes_observed:
+            self.max_inflight_bytes_observed = self._inflight_bytes
+
+    async def _admit(self, size: int) -> None:
+        budget = GLOBAL_CONFIG.pull_max_inflight_bytes
+        if budget <= 0:
+            self._inflight_bytes += size
+            self._set_gauges()
+            return
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._admit_q.append((size, fut))
+        self._queued_bytes += size
+        self._set_gauges()
+        self._pump_admission()
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # cancelled in the instant AFTER admission granted: give the
+            # bytes back — nobody else will
+            if fut.done() and not fut.cancelled():
+                self._release(size)
+            raise
+        finally:
+            self._queued_bytes -= size
+            self._set_gauges()
+
+    def _pump_admission(self) -> None:
+        budget = GLOBAL_CONFIG.pull_max_inflight_bytes
+        while self._admit_q:
+            size, fut = self._admit_q[0]
+            if fut.cancelled():
+                self._admit_q.popleft()
+                continue
+            # strict FIFO: the head parks the whole queue until it fits
+            # (no small-pull overtaking — starvation-free by design);
+            # an oversized object is admitted when nothing is in flight
+            if self._inflight_bytes > 0 and self._inflight_bytes + size > budget:
+                break
+            self._admit_q.popleft()
+            self._inflight_bytes += size
+            fut.set_result(None)
+        self._set_gauges()
+
+    def _release(self, size: int) -> None:
+        self._inflight_bytes -= size
+        self._pump_admission()
+
+    # -- source probing --------------------------------------------------
+    async def _probe(
+        self,
+        candidates: Deque[_Source],
+        object_id: ObjectID,
+        causes: Dict[str, str],
+    ):
+        """Pop candidates until one serves the transfer head
+        (``object_info``); record a cause per source that can't."""
+        while candidates:
+            src = candidates.popleft()
+            timeout = effective_timeout(10.0)
+            if timeout is not None and timeout <= 0:
+                # budget gone ≠ sources gone: this must surface as a
+                # TIMEOUT, never as "no source holds the object"
+                raise _PullAbort("deadline exhausted before probe", deadline=True)
+            try:
+                head = await self._peer(src[0], src[1]).call(
+                    "object_info",
+                    {"object_id": object_id.binary()},
+                    timeout=timeout,
+                )
+            except Exception as e:  # noqa: BLE001 — a dead source is a cause
+                causes[_addr(src)] = f"probe failed: {e!r}"
+                continue
+            if head is None:
+                causes[_addr(src)] = "object not found"
+                continue
+            return src, head
+        return None, None
+
+    async def _next_source(
+        self,
+        candidates: Deque[_Source],
+        object_id: ObjectID,
+        causes: Dict[str, str],
+        size: int,
+        digest: Optional[int],
+    ) -> Optional[_Source]:
+        """Failover: next candidate whose transfer head MATCHES the one
+        this transfer started from (an object is immutable, so a size or
+        digest disagreement marks a source corrupt, not the object new)."""
+        while True:
+            src, head = await self._probe(candidates, object_id, causes)
+            if src is None:
+                return None
+            if head["size"] != size or (
+                digest is not None
+                and head.get("digest") is not None
+                and head["digest"] != digest
+            ):
+                causes[_addr(src)] = (
+                    f"transfer metadata mismatch (size {head['size']} != {size})"
+                )
+                continue
+            return src
+
+    # -- the transfer ----------------------------------------------------
+    async def _pull(self, object_id: ObjectID, sources) -> Dict[str, object]:
+        from ray_tpu.observability.rpc_metrics import (
+            PULL_FAILURES,
+            PULL_INTEGRITY_FAILURES,
+            PULL_RESUMES,
+        )
+
+        plan = active_pull_fault_plan()
+        causes: Dict[str, str] = {}
+        candidates: Deque[_Source] = deque(
+            dict.fromkeys(tuple(s) for s in sources)
+        )
+        try:
+            src, head = await self._probe(candidates, object_id, causes)
+        except _PullAbort as e:
+            PULL_FAILURES.inc()
+            causes.setdefault("deadline" if e.deadline else "abort", str(e))
+            logger.warning(
+                "pull of %s aborted: %s (causes: %s)",
+                object_id.hex()[:12], e, causes,
+            )
+            return {
+                "failed": True,
+                "no_source": False,
+                "deadline": e.deadline,
+                "causes": causes,
+            }
+        if src is None:
+            PULL_FAILURES.inc()
+            logger.warning(
+                "pull of %s: no live source (causes: %s)",
+                object_id.hex()[:12], causes,
+            )
+            return {"failed": True, "no_source": True, "causes": causes}
+        size, digest = head["size"], head.get("digest")
+        admitted = False
+        allocated = False
+        seg = None
+        try:
+            await self._admit(size)
+            admitted = True
+            # re-check after (possibly) queueing: a local put or adopt
+            # may have landed while we were parked
+            meta = self.store.ensure_local(object_id)
+            if meta is not None:
+                return {"segment": meta[0], "size": meta[1]}
+            if not self.store.begin_receive(object_id):
+                meta = self.store.ensure_local(object_id)
+                if meta is not None:
+                    return {"segment": meta[0], "size": meta[1]}
+            name = self.store.allocate_receive(object_id, size)
+            allocated = True
+            seg = _attach(name)
+            buf = seg.buf
+            offset, crc = 0, 0
+            while True:
+                try:
+                    offset, crc = await self._stream_from(
+                        src, object_id, buf, size, offset, crc, plan
+                    )
+                except _SourceFailed as e:
+                    causes[_addr(src)] = str(e)
+                    # resume from the progress the failed source left
+                    # behind — every chunk written to buf was verified
+                    offset, crc = e.offset, e.crc
+                    nxt = await self._next_source(
+                        candidates, object_id, causes, size, digest
+                    )
+                    if nxt is None:
+                        raise _PullAbort("every source failed")
+                    if offset > 0:
+                        PULL_RESUMES.inc()  # resumed, not restarted
+                    src = nxt
+                    continue
+                # end-to-end gate before seal: the running crc over every
+                # verified chunk must equal the source-advertised digest
+                if digest is not None and crc != digest:
+                    PULL_INTEGRITY_FAILURES.inc()
+                    causes[_addr(src)] = "whole-object digest mismatch"
+                    nxt = await self._next_source(
+                        candidates, object_id, causes, size, digest
+                    )
+                    if nxt is None:
+                        raise _PullAbort("every source failed")
+                    src, offset, crc = nxt, 0, 0  # restart clean
+                    continue
+                break
+            self.store.seal_receive(object_id, digest=crc)
+            meta = self.store.ensure_local(object_id)
+            return {"segment": meta[0], "size": meta[1]}
+        except _PullAbort as e:
+            PULL_FAILURES.inc()
+            # the abort reason must survive into the structured causes —
+            # a deadline can expire with zero per-source entries yet
+            causes.setdefault("deadline" if e.deadline else _addr(src), str(e))
+            # ONE summary line for the whole pull, not a line per source
+            logger.warning(
+                "pull of %s failed: %s (causes: %s)",
+                object_id.hex()[:12], e, causes,
+            )
+            return {
+                "failed": True,
+                "no_source": False,
+                "deadline": e.deadline,
+                "causes": causes,
+            }
+        finally:
+            if seg is not None:
+                seg.close()
+            if allocated:
+                self.store.abort_receive(object_id)  # no-op once sealed
+            if admitted:
+                self._release(size)
+
+    async def _stream_from(
+        self,
+        src: _Source,
+        object_id: ObjectID,
+        buf,
+        size: int,
+        offset: int,
+        crc: int,
+        plan,
+    ) -> Tuple[int, int]:
+        """Stream chunks from one source into the destination segment
+        starting at ``offset``. Returns the final (offset, crc) on
+        completion; raises :class:`_SourceFailed` with progress already
+        durable in ``buf`` (the caller resumes elsewhere)."""
+        from ray_tpu.observability.rpc_metrics import (
+            PULL_CHUNK_RETRIES,
+            PULL_CHUNKS,
+            PULL_INTEGRITY_FAILURES,
+        )
+
+        client = self._peer(src[0], src[1])
+        chunk_bytes = GLOBAL_CONFIG.object_transfer_chunk_bytes
+        depth = max(1, GLOBAL_CONFIG.pull_pipeline_depth)
+        # pipelined prefetch (reference: pipelined 5 MiB chunks): up to
+        # ``depth`` chunk requests ride the connection concurrently so
+        # the wire stays busy while this side verifies + writes; the
+        # commit order (and the running crc) stays strictly sequential.
+        inflight: Dict[int, asyncio.Task] = {}
+        next_sched = offset
+        try:
+            while offset < size:
+                while next_sched < size and len(inflight) < depth:
+                    ln = min(chunk_bytes, size - next_sched)
+                    inflight[next_sched] = asyncio.ensure_future(
+                        self._fetch_chunk_once(
+                            client, object_id, next_sched, ln, plan
+                        )
+                    )
+                    next_sched += ln
+                length = min(chunk_bytes, size - offset)
+                first_task = inflight.pop(offset, None)
+                attempt = 0
+                while True:
+                    try:
+                        if first_task is not None:
+                            task, first_task = first_task, None
+                            data = await task
+                        else:
+                            data = await self._fetch_chunk_once(
+                                client, object_id, offset, length, plan
+                            )
+                        break
+                    except _ChunkIntegrityError:
+                        PULL_INTEGRITY_FAILURES.inc()
+                        reason = "integrity"
+                    except (asyncio.TimeoutError, TimeoutError):
+                        reason = "timeout"
+                    except _ChaosChunkError:
+                        reason = "chaos"
+                    except _SourceFailed as e:
+                        e.offset, e.crc = offset, crc  # stamp verified progress
+                        raise
+                    except KeyError as e:
+                        # the source no longer holds the object (freed or
+                        # evicted under it): not a retryable chunk fault
+                        raise _SourceFailed(
+                            f"source lost the object: {e}", offset=offset, crc=crc
+                        )
+                    except (ConnectionLost, OSError):
+                        reason = "transport"
+                    attempt += 1
+                    if attempt > GLOBAL_CONFIG.pull_chunk_retries:
+                        raise _SourceFailed(
+                            f"chunk at offset {offset} exhausted "
+                            f"{GLOBAL_CONFIG.pull_chunk_retries} retries ({reason})",
+                            offset=offset,
+                            crc=crc,
+                        )
+                    PULL_CHUNK_RETRIES.inc(labels={"reason": reason})
+                    if not await backoff_sleep(attempt):
+                        raise _PullAbort(
+                            "deadline exhausted mid-transfer", deadline=True
+                        )
+                # chunk verified: commit it. Only now does the running crc
+                # advance — a failover resumes exactly from here.
+                buf[offset : offset + len(data)] = data
+                crc = zlib.crc32(data, crc)
+                offset += len(data)
+                PULL_CHUNKS.inc()
+            return offset, crc
+        finally:
+            for t in inflight.values():
+                t.cancel()
+            if inflight:
+                # retrieve cancellations/failures so abandoned prefetch
+                # tasks never log "exception was never retrieved"
+                await asyncio.gather(*inflight.values(), return_exceptions=True)
+
+    async def _fetch_chunk_once(
+        self, client, object_id: ObjectID, offset: int, length: int, plan
+    ) -> bytes:
+        """One chunk attempt: chaos consult, bounded fetch, per-chunk
+        integrity verification. Never writes unverified bytes anywhere."""
+        mode = param = None
+        if plan is not None:
+            fault = plan.next_fault()
+            if fault is not None:
+                mode, param = fault
+                _count_injection(mode)
+                if mode == "chunk_drop":
+                    raise _ChaosChunkError("chaos: chunk dropped")
+                if mode == "source_die_mid_transfer":
+                    raise _SourceFailed("chaos: source died mid-transfer")
+                if mode == "chunk_stall":
+                    # the fetch wedges past its timeout: the stall costs
+                    # one chunk-timeout, then the retry machinery runs
+                    await asyncio.sleep(param)
+                    raise asyncio.TimeoutError("chaos: chunk stalled")
+        timeout = effective_timeout(GLOBAL_CONFIG.pull_chunk_timeout_s)
+        if timeout is not None and timeout <= 0:
+            raise _PullAbort("deadline exhausted mid-transfer", deadline=True)
+        reply = await client.call(
+            "fetch_chunk",
+            {
+                "object_id": object_id.binary(),
+                "offset": offset,
+                "length": length,
+            },
+            timeout=timeout,
+        )
+        if isinstance(reply, (bytes, bytearray, memoryview)):
+            data, chunk_crc = bytes(reply), None  # legacy sender (no crc)
+        else:
+            data, chunk_crc = reply
+        if mode == "chunk_corrupt" and data:
+            # flip one byte AFTER the sender computed the crc: the
+            # verification below MUST catch it (that's the assertion)
+            corrupted = bytearray(data)
+            corrupted[len(corrupted) // 2] ^= 0xFF
+            data = bytes(corrupted)
+        if chunk_crc is not None and zlib.crc32(data) != chunk_crc:
+            raise _ChunkIntegrityError(f"chunk crc mismatch at offset {offset}")
+        if len(data) != length:
+            raise _ChunkIntegrityError(
+                f"truncated chunk at offset {offset}: {len(data)} != {length}"
+            )
+        return data

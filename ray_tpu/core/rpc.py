@@ -98,7 +98,12 @@ IDEMPOTENT_METHODS: Dict[str, frozenset] = {
     "noded": frozenset(
         {
             "ping", "hello", "event_stats", "stats",
-            # pure reads over the object directory/store
+            # pure reads over the object directory/store. fetch_chunk /
+            # object_info / get_object_meta MUST stay here: dedup-stamped
+            # replies enter the bounded reply cache, and one multi-MiB
+            # chunk reply per request would evict every cached
+            # control-plane reply from the 32 MiB window (data-plane
+            # bulk replies never belong in the dedup cache)
             "list_objects", "get_object_meta", "object_info",
             "fetch_chunk",
             # idempotent-by-construction object/worker ops

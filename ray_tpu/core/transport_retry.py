@@ -1,0 +1,88 @@
+"""Shared transport-retry machinery for re-pushing one logical operation.
+
+Two call sites grew identical copies of this logic (the ordered actor
+batch pump and the direct actor submit path in ``core_worker.py``), and
+the pull manager's chunk retry loop needs the same backoff discipline —
+this module is the single home for both pieces:
+
+* :class:`PushBinding` — request-id reuse across re-pushes of ONE
+  logical operation to a (possibly moving) server. While the binding
+  targets the same client, every retry carries the SAME request id, so a
+  push whose reply was lost after execution is answered from the
+  server's dedup reply cache instead of running twice (``core/rpc.py``).
+  A new target (the actor moved, the batch changed) is a different
+  logical request and gets a fresh id.
+
+* :func:`backoff_sleep` — jittered exponential backoff capped by the
+  ambient ``core/deadline`` budget, the same discipline
+  ``rpc.RpcClient.call`` applies internally, for callers that manage
+  their own retry loops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Optional
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+
+
+class PushBinding:
+    """Tracks (target client → request id → transport-retry budget) for
+    one logical push. ``bind()`` on every loop iteration: a changed
+    client mints a fresh request id and resets the retry budget."""
+
+    __slots__ = ("client", "request_id", "transport_retries")
+
+    def __init__(self):
+        self.client = None
+        self.request_id: Optional[int] = None
+        self.transport_retries = 0
+
+    def bind(self, client) -> Optional[int]:
+        if client is not self.client:
+            self.client = client
+            self.request_id = client.next_request_id()
+            self.transport_retries = 0
+        return self.request_id
+
+    def invalidate(self) -> None:
+        """The next push is a DIFFERENT logical request (target moved,
+        payload changed): force a fresh request id on the next bind."""
+        self.client = None
+
+    def can_retry_same_target(self) -> bool:
+        return self.transport_retries < GLOBAL_CONFIG.rpc_max_retries
+
+    def note_retry(self) -> None:
+        self.transport_retries += 1
+
+
+def jittered_delay(attempt: int, *, base: Optional[float] = None,
+                   cap: Optional[float] = None) -> float:
+    """Exponential backoff delay for the Nth retry (attempt >= 1), with
+    the same half-to-full jitter as the RPC client's internal loop."""
+    base = base if base is not None else GLOBAL_CONFIG.rpc_retry_base_delay_s
+    cap = cap if cap is not None else GLOBAL_CONFIG.rpc_retry_max_delay_s
+    delay = min(base * (2 ** max(0, attempt - 1)), cap)
+    return delay * (0.5 + random.random() * 0.5)
+
+
+async def backoff_sleep(attempt: int, *, base: Optional[float] = None,
+                        cap: Optional[float] = None) -> bool:
+    """Sleep the jittered backoff for retry ``attempt``, capped by the
+    ambient ``core/deadline`` budget. Returns False WITHOUT sleeping when
+    the ambient budget is exhausted — the caller surfaces its last
+    failure instead of sleeping into a dead deadline."""
+    from ray_tpu.core.deadline import current_deadline
+
+    delay = jittered_delay(attempt, base=base, cap=cap)
+    ambient = current_deadline()
+    if ambient is not None:
+        remaining = ambient.remaining()
+        if remaining <= 0:
+            return False
+        delay = min(delay, remaining)
+    await asyncio.sleep(delay)
+    return True

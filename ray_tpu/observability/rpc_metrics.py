@@ -11,7 +11,7 @@ them for free.
 
 from __future__ import annotations
 
-from ray_tpu.observability.metrics import Counter
+from ray_tpu.observability.metrics import Counter, Gauge
 
 #: client-side RPC retry attempts (one inc per re-sent attempt)
 RPC_RETRIES = Counter(
@@ -41,6 +41,64 @@ CONTROLLER_RECONNECTS = Counter(
     "raytpu_controller_reconnects_total",
     "controller connection re-establishments (re-register/re-subscribe)",
     ("role",),
+)
+
+# -- pull manager (core/pull_manager.py) ------------------------------------
+# The data plane's fault-tolerance activity: how many chunks moved, how
+# often a chunk was retried (and why), how often a transfer failed over
+# to another source mid-flight, and whether integrity checks ever fired.
+# Counters are per-process (the pulling daemon); the gauges expose the
+# admission controller's live state.
+
+#: chunks fetched and VERIFIED (crc match) by the pull manager
+PULL_CHUNKS = Counter(
+    "raytpu_pull_chunks_total",
+    "object-transfer chunks fetched and verified by the pull manager",
+)
+
+#: chunk attempts retried, by reason (timeout | transport | integrity |
+#: chaos — chaos covers injected chunk_drop/chunk_stall faults)
+PULL_CHUNK_RETRIES = Counter(
+    "raytpu_pull_chunk_retries_total",
+    "object-transfer chunk fetch retries, by reason",
+    ("reason",),
+)
+
+#: mid-transfer source failovers that RESUMED from the last verified
+#: offset on another source (instead of restarting from byte 0)
+PULL_RESUMES = Counter(
+    "raytpu_pull_resumes_total",
+    "mid-transfer source failovers resumed from the last verified offset",
+)
+
+#: chunks whose content digest did not match — detected BEFORE the data
+#: could reach the destination segment (each one is re-fetched)
+PULL_INTEGRITY_FAILURES = Counter(
+    "raytpu_pull_integrity_failures_total",
+    "object-transfer chunks rejected by integrity verification",
+)
+
+#: concurrent pulls of one object coalesced onto an in-flight transfer
+PULL_COALESCED = Counter(
+    "raytpu_pull_coalesced_total",
+    "duplicate concurrent pulls coalesced onto one in-flight transfer",
+)
+
+#: transfers that exhausted every source (structured failure returned)
+PULL_FAILURES = Counter(
+    "raytpu_pull_failures_total",
+    "pulls that failed after exhausting every source",
+)
+
+#: bytes of transfers currently admitted (in flight) / parked FIFO
+#: behind the pull_max_inflight_bytes budget
+PULL_INFLIGHT_BYTES = Gauge(
+    "raytpu_pull_inflight_bytes",
+    "bytes of object transfers currently in flight (admitted)",
+)
+PULL_QUEUED_BYTES = Gauge(
+    "raytpu_pull_queued_bytes",
+    "bytes of object transfers queued behind the admission budget",
 )
 
 # -- serve router decisions (serve/router.py) -------------------------------

@@ -87,6 +87,79 @@ class RpcFaultPlan:
         return None
 
 
+#: Data-plane chaos fault modes (consulted by the PULL manager once per
+#: chunk attempt — receiver-side, so the whole schedule lives in one
+#: process and replays from one seed; see ``core/pull_manager.py``).
+#: chunk_drop — the chunk fetch fails before any data lands (retry path).
+#: chunk_corrupt — the chunk arrives with flipped bytes; the per-chunk
+#:   crc MUST catch it before the data reaches the destination segment.
+#: chunk_stall — the fetch stalls ``param`` seconds then times out
+#:   (exercises the per-chunk timeout machinery).
+#: source_die_mid_transfer — the current source becomes unreachable for
+#:   the rest of this transfer: the pull must fail over to another
+#:   source and RESUME from the last verified offset.
+DATA_FAULT_MODES = (
+    "chunk_drop", "chunk_corrupt", "chunk_stall", "source_die_mid_transfer",
+)
+
+
+class DataFaultPlan:
+    """Seeded data-plane fault plan for object transfer
+    (``RAY_TPU_testing_pull_chaos``).
+
+    Spec grammar::
+
+        "<mode>:<prob>[:<param>][, ...]"
+
+    e.g. ``"chunk_corrupt:0.2,chunk_stall:0.05:0.3"``. Rules are
+    consulted in order; the FIRST rule whose probability fires wins.
+    ``param`` is the stall seconds for ``chunk_stall`` (default 0.05)
+    and ignored otherwise.
+
+    DETERMINISM CONTRACT (same as :class:`RpcFaultPlan`): exactly one
+    RNG draw per rule per :meth:`next_fault` consult, in rule order —
+    the full injection sequence is a pure function of (seed, number of
+    consults). A failure log carrying the seed plus the spec reproduces
+    the exact fault schedule.
+    """
+
+    def __init__(self, spec: str, seed: int):
+        self.spec = spec
+        self.seed = seed
+        self.rules: List[Tuple[str, float, float]] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) < 2:
+                raise ValueError(f"bad pull chaos rule {part!r} (need mode:prob)")
+            mode, prob = fields[0], float(fields[1])
+            if mode not in DATA_FAULT_MODES:
+                raise ValueError(
+                    f"unknown pull chaos mode {mode!r} (one of {DATA_FAULT_MODES})"
+                )
+            param = float(fields[2]) if len(fields) > 2 else 0.05
+            self.rules.append((mode, prob, param))
+        self._rng = random.Random(seed)
+        self.consults = 0
+        self.injections = 0
+
+    def next_fault(self) -> Optional[Tuple[str, float]]:
+        """One deterministic consult: ``(mode, param)`` to inject into
+        this chunk attempt, else None. A fixed number of draws happens
+        regardless of outcome (one per rule) — see the class docstring."""
+        self.consults += 1
+        hit: Optional[Tuple[str, float]] = None
+        for mode, prob, param in self.rules:
+            draw = self._rng.random()  # ALWAYS drawn, even after a hit
+            if hit is None and draw < prob:
+                hit = (mode, param)
+        if hit is not None:
+            self.injections += 1
+        return hit
+
+
 def find_worker_pids(controller_addr: str) -> List[int]:
     """PIDs of worker_main processes attached to ``controller_addr``
     (shared /proc scan: ``util/reaper.py::find_runtime_pids``)."""

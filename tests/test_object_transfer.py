@@ -1,0 +1,679 @@
+"""Fault-tolerant data plane: pull-manager chaos suite.
+
+Covers the PR-8 acceptance gates:
+  * streaming shm receive — readers NEVER see an unsealed (mid-transfer)
+    object;
+  * seeded data-plane chaos (chunk_drop / chunk_corrupt / chunk_stall /
+    source_die_mid_transfer): corrupted chunks are detected and
+    re-fetched, stalls/drops retry, transfers survive;
+  * mid-transfer source death resumes from the last verified offset on a
+    surviving source (one chunk lost, not the object);
+  * admission control: concurrent pulls respect pull_max_inflight_bytes
+    with FIFO queueing; same-object pulls coalesce onto one transfer;
+  * structured failure results distinguishing "no source has it" from
+    "every transfer failed", with per-source causes;
+  * spilled-source serving: restore-and-serve through read_range under
+    concurrent pulls, no double restore, pinned segments untouched;
+  * E2E: multi-node workload with the source node SIGKILLed mid-run —
+    zero wrong or missing results.
+"""
+
+import asyncio
+import threading
+import time
+import zlib
+
+import pytest
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.ids import JobID, ObjectID, TaskID
+from ray_tpu.core.object_store import ShmStore
+from ray_tpu.core.pull_manager import PullManager
+from ray_tpu.core.rpc import IoThread, RpcClient, RpcServer, idempotent_methods
+from ray_tpu.util.chaos import DataFaultPlan
+
+
+def oid(i: int) -> ObjectID:
+    return ObjectID.for_put(TaskID.for_driver(JobID.from_index(7)), i)
+
+
+def _counter_total(counter) -> float:
+    return sum(counter._values.values())  # noqa: SLF001 — test introspection
+
+
+@pytest.fixture
+def io():
+    t = IoThread("transfer-test-io")
+    yield t
+    t.stop()
+
+
+@pytest.fixture(autouse=True)
+def _pull_knobs():
+    """Small chunks so multi-chunk behavior is cheap to exercise; reset
+    every knob (and the chaos plan) afterwards."""
+    old = (
+        GLOBAL_CONFIG.object_transfer_chunk_bytes,
+        GLOBAL_CONFIG.pull_chunk_timeout_s,
+        GLOBAL_CONFIG.pull_chunk_retries,
+        GLOBAL_CONFIG.pull_max_inflight_bytes,
+        GLOBAL_CONFIG.testing_pull_chaos,
+        GLOBAL_CONFIG.testing_pull_chaos_seed,
+    )
+    GLOBAL_CONFIG.object_transfer_chunk_bytes = 64 * 1024
+    GLOBAL_CONFIG.pull_chunk_timeout_s = 5.0
+    yield
+    (
+        GLOBAL_CONFIG.object_transfer_chunk_bytes,
+        GLOBAL_CONFIG.pull_chunk_timeout_s,
+        GLOBAL_CONFIG.pull_chunk_retries,
+        GLOBAL_CONFIG.pull_max_inflight_bytes,
+        GLOBAL_CONFIG.testing_pull_chaos,
+        GLOBAL_CONFIG.testing_pull_chaos_seed,
+    ) = old
+
+
+class FakeSource:
+    """A source daemon's transfer surface (object_info/fetch_chunk) over
+    an in-memory object dict — no shm segment on the source side, so the
+    destination's streaming writes are the only /dev/shm activity.
+
+    Knobs: ``die_after_chunks`` aborts the connection once N chunks were
+    served (every later fetch aborts too — the source is "dead");
+    ``chunk_delay_s`` paces chunks so tests can observe in-flight state;
+    ``lose_objects_after`` makes fetch_chunk raise KeyError after N
+    chunks (the source evicted the object mid-transfer)."""
+
+    def __init__(
+        self,
+        io: IoThread,
+        objects,
+        *,
+        die_after_chunks=None,
+        chunk_delay_s=0.0,
+        lose_objects_after=None,
+        no_chunk_crc=False,
+    ):
+        self.io = io
+        self.objects = dict(objects)
+        self.die_after_chunks = die_after_chunks
+        self.chunk_delay_s = chunk_delay_s
+        self.lose_objects_after = lose_objects_after
+        self.no_chunk_crc = no_chunk_crc
+        self.info_calls = 0
+        self.served_chunks = 0
+
+        async def _setup():
+            server = RpcServer()
+            server.register("object_info", self._object_info)
+            server.register("fetch_chunk", self._fetch_chunk)
+            port = await server.start()
+            return server, port
+
+        self.server, self.port = io.run(_setup())
+
+    async def _object_info(self, payload, conn):
+        self.info_calls += 1
+        data = self.objects.get(payload["object_id"])
+        if data is None:
+            return None
+        return {"size": len(data), "digest": zlib.crc32(data)}
+
+    async def _fetch_chunk(self, payload, conn):
+        if (
+            self.die_after_chunks is not None
+            and self.served_chunks >= self.die_after_chunks
+        ):
+            conn.abort()  # hard reset: the puller sees ConnectionLost
+            raise ConnectionError("source died")
+        if self.chunk_delay_s:
+            await asyncio.sleep(self.chunk_delay_s)
+        if (
+            self.lose_objects_after is not None
+            and self.served_chunks >= self.lose_objects_after
+        ):
+            raise KeyError("object evicted")
+        data = self.objects[payload["object_id"]]
+        chunk = data[payload["offset"] : payload["offset"] + payload["length"]]
+        self.served_chunks += 1
+        if self.no_chunk_crc:
+            return chunk  # legacy sender shape (raw bytes)
+        return (chunk, zlib.crc32(chunk))
+
+    def addr(self):
+        return ("127.0.0.1", self.port)
+
+    def stop(self):
+        self.io.run(self.server.stop())
+
+
+class Harness:
+    """Destination store + pull manager + cached peer clients."""
+
+    def __init__(self, io: IoThread, tmp_path):
+        self.io = io
+        self.store = ShmStore(
+            capacity_bytes=64 * 1024 * 1024, spill_dir=str(tmp_path / "dst")
+        )
+        self._clients = {}
+        self.pm = PullManager(self.store, self._peer)
+
+    def _peer(self, host, port):
+        key = (host, port)
+        c = self._clients.get(key)
+        if c is None:
+            c = self._clients[key] = RpcClient(
+                host, port, name=f"peer-{port}", role="noded"
+            )
+        return c
+
+    def pull(self, object_id, sources, timeout=60):
+        return self.io.run(
+            self.pm.pull(object_id, [s.addr() if isinstance(s, FakeSource) else s for s in sources]),
+            timeout=timeout,
+        )
+
+    def read(self, object_id) -> bytes:
+        data = self.store.read_bytes(object_id)
+        assert data is not None
+        return data
+
+    def close(self):
+        async def _close():
+            for c in self._clients.values():
+                await c.close()
+
+        self.io.run(_close())
+        self.store.shutdown()
+
+
+@pytest.fixture
+def harness(io, tmp_path):
+    h = Harness(io, tmp_path)
+    yield h
+    h.close()
+
+
+def _payload(n_chunks: int, seed: int = 0) -> bytes:
+    import numpy as np
+
+    chunk = GLOBAL_CONFIG.object_transfer_chunk_bytes
+    rs = np.random.RandomState(seed)
+    return rs.bytes(chunk * n_chunks - 37)  # odd size: last chunk partial
+
+
+# ---------------------------------------------------------------------------
+# basics: streaming receive, digest carry, legacy reply shape
+
+
+def test_basic_pull_and_integrity_seal(io, harness):
+    o = oid(1)
+    payload = _payload(4)
+    src = FakeSource(io, {o.binary(): payload})
+    try:
+        reply = harness.pull(o, [src])
+        assert reply.get("segment") and reply["size"] == len(payload)
+        assert harness.read(o) == payload
+        # digest recorded at seal: this node can now serve object_info
+        # without recomputing
+        assert harness.store.digest_of(o) == zlib.crc32(payload)
+        # idempotent local re-pull answers from the store
+        again = harness.pull(o, [src])
+        assert again["size"] == len(payload)
+    finally:
+        src.stop()
+
+
+def test_legacy_raw_chunk_reply_still_works(io, harness):
+    o = oid(2)
+    payload = _payload(3)
+    src = FakeSource(io, {o.binary(): payload}, no_chunk_crc=True)
+    try:
+        reply = harness.pull(o, [src])
+        assert reply.get("segment")
+        assert harness.read(o) == payload  # whole-object digest still verified
+    finally:
+        src.stop()
+
+
+def test_unsealed_entry_invisible_to_readers(io, harness):
+    """Mid-transfer, the destination store must deny any knowledge of the
+    object — a reader can never attach a partially-written segment."""
+    o = oid(3)
+    payload = _payload(8)
+    src = FakeSource(io, {o.binary(): payload}, chunk_delay_s=0.2)
+    try:
+        fut = io.post(harness.pm.pull(o, [src.addr()]))  # noqa: F841
+        deadline = time.monotonic() + 10
+        while src.served_chunks < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert src.served_chunks < 8, "transfer finished too fast to observe"
+        # in flight: invisible
+        assert harness.store.ensure_local(o) is None
+        assert harness.store.contains(o) is False
+        assert harness.store.read_range(o, 0, 10) is None
+        # completion: visible and exact
+        deadline = time.monotonic() + 30
+        while harness.store.ensure_local(o) is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert harness.read(o) == payload
+    finally:
+        src.stop()
+
+
+# ---------------------------------------------------------------------------
+# seeded data-plane chaos
+
+
+def _chaos(spec: str, seed: int):
+    GLOBAL_CONFIG.testing_pull_chaos = spec
+    GLOBAL_CONFIG.testing_pull_chaos_seed = seed
+
+
+def test_chunk_corrupt_detected_and_refetched(io, harness):
+    """A corrupted chunk fails its crc BEFORE touching the destination
+    segment and is re-fetched — the reader sees exact bytes, always."""
+    from ray_tpu.observability.rpc_metrics import PULL_INTEGRITY_FAILURES
+
+    _chaos("chunk_corrupt:0.35", 20260804)
+    plan = DataFaultPlan("chunk_corrupt:0.35", 20260804)
+    assert any(plan.next_fault() for _ in range(10)), "seed precondition"
+    o = oid(4)
+    payload = _payload(8)
+    src = FakeSource(io, {o.binary(): payload})
+    before = _counter_total(PULL_INTEGRITY_FAILURES)
+    try:
+        reply = harness.pull(o, [src])
+        assert reply.get("segment")
+        assert harness.read(o) == payload
+        assert _counter_total(PULL_INTEGRITY_FAILURES) > before
+    finally:
+        src.stop()
+
+
+def test_chunk_drop_and_stall_retry(io, harness):
+    from ray_tpu.observability.rpc_metrics import PULL_CHUNK_RETRIES
+
+    GLOBAL_CONFIG.pull_chunk_retries = 8  # plenty for a 0.3 drop rate
+    _chaos("chunk_drop:0.2,chunk_stall:0.1:0.05", 77)
+    plan = DataFaultPlan("chunk_drop:0.2,chunk_stall:0.1:0.05", 77)
+    assert any(plan.next_fault() for _ in range(10)), "seed precondition"
+    o = oid(5)
+    payload = _payload(8)
+    src = FakeSource(io, {o.binary(): payload})
+    before = _counter_total(PULL_CHUNK_RETRIES)
+    try:
+        reply = harness.pull(o, [src])
+        assert reply.get("segment")
+        assert harness.read(o) == payload
+        assert _counter_total(PULL_CHUNK_RETRIES) > before
+    finally:
+        src.stop()
+
+
+def test_chaos_source_die_fails_over(io, harness):
+    """The seeded source_die_mid_transfer mode kills the current source;
+    with a surviving replica the pull completes exactly."""
+    spec, seed = "source_die_mid_transfer:0.08", 2
+    plan = DataFaultPlan(spec, seed)
+    faults = [plan.next_fault() for _ in range(20)]
+    idx = [i for i, f in enumerate(faults) if f]
+    # precondition on this pinned seed: the first death lands before the
+    # 16-chunk transfer can finish, and ≤2 deaths total (3 sources)
+    assert idx and idx[0] < 12 and len(idx) <= 2, f"seed precondition: {idx}"
+    _chaos(spec, seed)
+    o = oid(6)
+    payload = _payload(16)
+    src_a = FakeSource(io, {o.binary(): payload})
+    src_b = FakeSource(io, {o.binary(): payload})
+    src_c = FakeSource(io, {o.binary(): payload})
+    try:
+        reply = harness.pull(o, [src_a, src_b, src_c])
+        assert reply.get("segment"), reply
+        assert harness.read(o) == payload
+        # at least one source never finished the job alone
+        assert src_a.served_chunks < 16
+    finally:
+        for s in (src_a, src_b, src_c):
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# resumable multi-source failover (deterministic, no chaos plan)
+
+
+def test_source_death_resumes_from_verified_offset(io, harness):
+    """Source A dies after 5 chunks: the transfer fails over to B and
+    resumes — B serves only the REMAINING chunks, never the whole object."""
+    from ray_tpu.observability.rpc_metrics import PULL_RESUMES
+
+    GLOBAL_CONFIG.pull_chunk_retries = 0  # first transport loss → failover
+    o = oid(7)
+    n_chunks = 12
+    payload = _payload(n_chunks)
+    src_a = FakeSource(io, {o.binary(): payload}, die_after_chunks=5)
+    src_b = FakeSource(io, {o.binary(): payload})
+    before = _counter_total(PULL_RESUMES)
+    try:
+        reply = harness.pull(o, [src_a, src_b])
+        assert reply.get("segment")
+        assert harness.read(o) == payload
+        assert src_a.served_chunks == 5
+        # resumed from the last VERIFIED offset: B serves the remainder
+        # (allow one chunk of slack — A's death can discard a reply it
+        # already "served" from its cork buffer), never the whole object
+        assert n_chunks - 5 <= src_b.served_chunks <= n_chunks - 4
+        assert src_b.served_chunks < n_chunks, "restarted instead of resuming"
+        assert _counter_total(PULL_RESUMES) > before
+    finally:
+        src_a.stop()
+        src_b.stop()
+
+
+def test_source_losing_object_fails_over_immediately(io, harness):
+    """KeyError from the source (object freed under the transfer) is not
+    a retryable chunk fault — it's an immediate failover."""
+    GLOBAL_CONFIG.pull_chunk_retries = 5
+    o = oid(8)
+    payload = _payload(6)
+    src_a = FakeSource(io, {o.binary(): payload}, lose_objects_after=2)
+    src_b = FakeSource(io, {o.binary(): payload})
+    try:
+        reply = harness.pull(o, [src_a, src_b])
+        assert reply.get("segment")
+        assert harness.read(o) == payload
+        assert src_a.served_chunks == 2  # no retry burned on a gone object
+    finally:
+        src_a.stop()
+        src_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission control + single-flight
+
+
+def test_admission_control_bounds_inflight_bytes(io, harness):
+    """N concurrent pulls queue FIFO behind pull_max_inflight_bytes: the
+    admitted high-water mark never exceeds the budget, yet every pull
+    completes exactly."""
+    chunk = GLOBAL_CONFIG.object_transfer_chunk_bytes
+    size = 4 * chunk  # ~256 KiB per object
+    budget = 2 * size + chunk  # two objects in flight, not four
+    GLOBAL_CONFIG.pull_max_inflight_bytes = budget
+    objs = {}
+    ids = []
+    for i in range(4):
+        o = oid(20 + i)
+        import numpy as np
+
+        payload = np.random.RandomState(i).bytes(size)
+        objs[o.binary()] = payload
+        ids.append((o, payload))
+    src = FakeSource(io, objs, chunk_delay_s=0.02)
+    try:
+        async def _all():
+            return await asyncio.gather(
+                *[harness.pm.pull(o, [src.addr()]) for o, _ in ids]
+            )
+
+        replies = io.run(_all(), timeout=120)
+        assert all(r.get("segment") for r in replies), replies
+        for o, payload in ids:
+            assert harness.read(o) == payload
+        assert harness.pm.max_inflight_bytes_observed <= budget
+        assert harness.pm._inflight_bytes == 0  # noqa: SLF001 — budget returned
+    finally:
+        src.stop()
+
+
+def test_same_object_pulls_coalesce(io, harness):
+    """Concurrent pulls of ONE object share a single transfer: the
+    source sees one probe and one set of chunks."""
+    from ray_tpu.observability.rpc_metrics import PULL_COALESCED
+
+    o = oid(30)
+    n_chunks = 6
+    payload = _payload(n_chunks)
+    src = FakeSource(io, {o.binary(): payload}, chunk_delay_s=0.03)
+    before = _counter_total(PULL_COALESCED)
+    try:
+        async def _both():
+            return await asyncio.gather(
+                harness.pm.pull(o, [src.addr()]),
+                harness.pm.pull(o, [src.addr()]),
+                harness.pm.pull(o, [src.addr()]),
+            )
+
+        replies = io.run(_both(), timeout=60)
+        assert all(r.get("segment") for r in replies)
+        assert src.info_calls == 1
+        assert src.served_chunks == n_chunks
+        assert _counter_total(PULL_COALESCED) >= before + 2
+        assert harness.read(o) == payload
+    finally:
+        src.stop()
+
+
+# ---------------------------------------------------------------------------
+# structured failure results
+
+
+def test_structured_failure_no_source(io, harness):
+    o = oid(40)
+    src = FakeSource(io, {})  # doesn't hold the object
+    try:
+        reply = harness.pull(o, [src])
+        assert reply["failed"] is True
+        assert reply["no_source"] is True
+        (cause,) = reply["causes"].values()
+        assert cause == "object not found"
+    finally:
+        src.stop()
+
+
+def test_structured_failure_all_transfers_failed(io, harness):
+    """Sources exist and advertise the object, but every transfer dies:
+    the failure is NOT 'no source' and carries a cause per source."""
+    GLOBAL_CONFIG.pull_chunk_retries = 0
+    o = oid(41)
+    payload = _payload(4)
+    src_a = FakeSource(io, {o.binary(): payload}, die_after_chunks=1)
+    src_b = FakeSource(io, {o.binary(): payload}, die_after_chunks=2)
+    try:
+        reply = harness.pull(o, [src_a, src_b])
+        assert reply["failed"] is True
+        assert reply["no_source"] is False
+        assert len(reply["causes"]) == 2
+        # nothing half-written left behind
+        assert harness.store.ensure_local(o) is None
+        assert harness.store.used_bytes == 0
+    finally:
+        src_a.stop()
+        src_b.stop()
+
+
+def test_pull_empty_sources(io, harness):
+    reply = harness.pull(oid(42), [])
+    assert reply["failed"] is True and reply["no_source"] is True
+
+
+def test_deadline_exhaustion_is_timeout_not_object_loss(io, harness):
+    """A pull that runs out of the caller's budget must NOT be classified
+    as 'no source holds it' — live sources + no budget is a timeout (the
+    owner maps it to GetTimeoutError, never lineage reconstruction)."""
+    o = oid(43)
+    payload = _payload(4)
+    src = FakeSource(io, {o.binary(): payload})
+    try:
+        async def _run():
+            from ray_tpu.core.deadline import deadline_scope
+
+            with deadline_scope(0.0):
+                return await harness.pm.pull(o, [src.addr()])
+
+        reply = io.run(_run())
+        assert reply["failed"] is True
+        assert reply["no_source"] is False
+        assert reply["deadline"] is True
+        assert reply["causes"], "abort reason must be recorded"
+    finally:
+        src.stop()
+
+
+# ---------------------------------------------------------------------------
+# idempotent-method classification (satellite: bulk chunk replies must
+# never churn the bounded dedup reply cache)
+
+
+def test_transfer_reads_classified_idempotent_for_noded():
+    methods = idempotent_methods("noded")
+    for m in ("object_info", "fetch_chunk", "get_object_meta", "pull_object"):
+        assert m in methods, m
+
+
+# ---------------------------------------------------------------------------
+# spilled-source serving (satellite): restore-and-serve via read_range
+# under concurrent pulls — one restore, pinned segments untouched
+
+
+class StoreSource:
+    """A source with a REAL ShmStore behind the daemon's transfer
+    handlers (the spill/restore path under serve load)."""
+
+    def __init__(self, io: IoThread, tmp_path, capacity=4 * 1024 * 1024):
+        self.io = io
+        self.store = ShmStore(capacity_bytes=capacity, spill_dir=str(tmp_path / "srcspill"))
+
+        async def _setup():
+            server = RpcServer()
+
+            async def object_info(payload, conn):
+                o = ObjectID(payload["object_id"])
+                meta = self.store.ensure_local(o)
+                if meta is None:
+                    return None
+                return {"size": meta[1], "digest": self.store.digest_of(o)}
+
+            async def fetch_chunk(payload, conn):
+                o = ObjectID(payload["object_id"])
+                data = self.store.read_range(o, payload["offset"], payload["length"])
+                if data is None:
+                    raise KeyError("not here")
+                return (data, zlib.crc32(data))
+
+            server.register("object_info", object_info)
+            server.register("fetch_chunk", fetch_chunk)
+            port = await server.start()
+            return server, port
+
+        self.server, self.port = io.run(_setup())
+
+    def addr(self):
+        return ("127.0.0.1", self.port)
+
+    def stop(self):
+        self.io.run(self.server.stop())
+        self.store.shutdown()
+
+
+def test_spilled_source_restores_once_and_spares_pinned(io, tmp_path):
+    src = StoreSource(io, tmp_path, capacity=4 * 1024 * 1024)
+    h1 = Harness(io, tmp_path / "d1")
+    h2_store = ShmStore(capacity_bytes=64 * 1024 * 1024, spill_dir=str(tmp_path / "d2"))
+    pm2 = PullManager(h2_store, h1._peer)  # share the client cache
+    pinned = oid(50)
+    spilled = oid(51)
+    victim = oid(52)
+    try:
+        import numpy as np
+
+        pinned_data = np.random.RandomState(1).bytes(1024 * 1024)
+        spilled_data = np.random.RandomState(2).bytes(int(1.5 * 1024 * 1024))
+        victim_data = np.random.RandomState(3).bytes(1024 * 1024)
+        src.store.create_with_data(pinned, memoryview(pinned_data))
+        src.store.pin(pinned)
+        src.store.create_with_data(victim, memoryview(victim_data))
+        src.store.create_with_data(spilled, memoryview(spilled_data))
+        # force the target object out to disk
+        with src.store._lock:  # noqa: SLF001 — test-only forcing
+            assert src.store._spill_one()  # LRU-first unpinned = `victim`? no: oldest unpinned
+        # spill until the target object is actually on disk
+        while any(
+            e["object_id"] == spilled.hex() and e["in_shm"]
+            for e in src.store.list_entries()
+        ):
+            with src.store._lock:  # noqa: SLF001
+                assert src.store._spill_one()
+        restored_before = src.store.num_restored
+
+        async def _both():
+            return await asyncio.gather(
+                h1.pm.pull(spilled, [src.addr()]),
+                pm2.pull(spilled, [src.addr()]),
+            )
+
+        r1, r2 = io.run(_both(), timeout=60)
+        assert r1.get("segment") and r2.get("segment")
+        assert h1.read(spilled) == spilled_data
+        assert h2_store.read_bytes(spilled) == spilled_data
+        # exactly ONE restore served both concurrent pulls
+        assert src.store.num_restored == restored_before + 1
+        # the pinned object was never spilled or unlinked by the restore
+        entries = {e["object_id"]: e for e in src.store.list_entries()}
+        assert entries[pinned.hex()]["in_shm"] is True
+        assert src.store.read_bytes(pinned) == pinned_data
+    finally:
+        src.stop()
+        h1.close()
+        h2_store.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# E2E: multi-node workload, source node SIGKILLed mid-run — zero wrong
+# or missing results (transfer failover + lineage reconstruction)
+
+
+def test_e2e_source_node_killed_mid_transfer():
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = None
+    try:
+        cluster = Cluster(num_cpus=2)
+        n2 = cluster.add_node(num_cpus=2, resources={"src": 8})
+        time.sleep(1.0)
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(max_retries=5, resources={"src": 1})
+        def produce(i):
+            time.sleep(0.3)  # stagger production so the kill lands mid-stream
+            return np.full((512 * 1024,), float(i), dtype=np.float64)  # 4 MiB
+
+        @ray_tpu.remote(max_retries=5, num_cpus=0.5)
+        def consume(a):
+            return float(a.sum())
+
+        refs = [produce.remote(i) for i in range(10)]
+        sums = [consume.remote(r) for r in refs]
+
+        def _kill_and_replace():
+            time.sleep(1.2)  # let production + transfers start
+            cluster.remove_node(n2)  # SIGKILL the whole node group
+            # replacement capacity so lineage reconstruction of lost
+            # producer outputs has somewhere to run
+            cluster.add_node(num_cpus=2, resources={"src": 8})
+
+        killer = threading.Thread(target=_kill_and_replace, daemon=True)
+        killer.start()
+        results = ray_tpu.get(sums, timeout=150)
+        killer.join(timeout=30)
+        expect = [float(i) * 512 * 1024 for i in range(10)]
+        assert results == expect, (results, expect)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            if cluster is not None:
+                cluster.shutdown()
