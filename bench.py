@@ -32,6 +32,30 @@ BASELINES = {
 }
 
 
+def _phase_trace(phase: str, fn: Callable[[], None]) -> None:
+    """Run one bench phase and write its chrome-trace artifact
+    (``BENCH_TRACE_<phase>.json``, next to BENCH_DETAILS.json): a perf
+    regression in a trajectory ships WITH the timeline that explains it.
+    The buffer is cleared per phase so each artifact is self-contained;
+    the dump is best-effort (driver-side events always land — worker
+    events only if a cluster is still connected at dump time)."""
+    from ray_tpu.observability import timeline
+
+    timeline.clear_events()
+    try:
+        fn()
+    finally:
+        try:
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                f"BENCH_TRACE_{phase}.json",
+            )
+            timeline.dump_timeline(path)
+            print(f"trace artifact: {path}", file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 — artifacts never fail a bench
+            print(f"trace artifact for {phase} failed: {e!r}", file=sys.stderr)
+
+
 def _timeit(fn: Callable[[], int], min_time: float = 2.0) -> float:
     """Run fn (returns ops count) until min_time elapsed; return ops/s."""
     # warmup
@@ -684,25 +708,25 @@ def main() -> None:
     results["machine_cpus"] = {"value": os.cpu_count() or 1, "unit": "vCPU"}
     print("== runtime microbenchmarks ==", file=sys.stderr, flush=True)
     try:
-        bench_runtime(results)
+        _phase_trace("runtime", lambda: bench_runtime(results))
     except Exception as e:  # noqa: BLE001
         results["runtime_error"] = {"error": repr(e)}
         print(f"runtime bench failed: {e!r}", file=sys.stderr, flush=True)
     print("== data plane (cross-node pull) ==", file=sys.stderr, flush=True)
     try:
-        bench_data_plane(results)
+        _phase_trace("data_plane", lambda: bench_data_plane(results))
     except Exception as e:  # noqa: BLE001
         results["data_plane_error"] = {"error": repr(e)}
         print(f"data plane bench failed: {e!r}", file=sys.stderr, flush=True)
     print("== serve LLM benchmarks ==", file=sys.stderr, flush=True)
     try:
-        bench_serve_llm(results)
+        _phase_trace("serve_llm", lambda: bench_serve_llm(results))
     except Exception as e:  # noqa: BLE001
         results["serve_llm_error"] = {"error": repr(e)}
         print(f"serve llm bench failed: {e!r}", file=sys.stderr, flush=True)
     print("== TPU compute benchmarks ==", file=sys.stderr, flush=True)
     try:
-        bench_tpu(results)
+        _phase_trace("tpu", lambda: bench_tpu(results))
     except Exception as e:  # noqa: BLE001
         results["tpu_error"] = {"error": repr(e)}
         print(f"tpu bench failed: {e!r}", file=sys.stderr, flush=True)

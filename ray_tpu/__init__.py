@@ -61,6 +61,7 @@ __all__ = [
     "drain_node",
     "cluster_resources",
     "available_resources",
+    "cluster_status",
     "free",
     "timeline",
     "Deadline",
@@ -157,6 +158,27 @@ def drain_node(node_id, reason: str = "drain requested") -> bool:
 
 def cluster_resources():
     return _api._global_worker().backend.cluster_resources()
+
+
+def cluster_status():
+    """Live cluster state in one call (the ``ray list`` equivalent):
+    ``{"nodes", "actors", "tasks": {"summary", "recent"}, "objects",
+    "placement_groups", "jobs"}`` from the controller's bounded tables.
+    Serve replicas are actors — their liveness shows up in ``actors``
+    within one resource-sync/poll period."""
+    backend = _api._global_worker().backend
+    fn = getattr(backend, "cluster_status", None)
+    if fn is None:
+        # local mode: synthesize the same shape from what exists
+        return {
+            "nodes": backend.nodes(),
+            "actors": [],
+            "tasks": {"summary": {}, "recent": []},
+            "objects": {},
+            "placement_groups": {},
+            "jobs": [],
+        }
+    return fn()
 
 
 def available_resources():

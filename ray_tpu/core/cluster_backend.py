@@ -39,7 +39,34 @@ def _subprocess_env() -> dict:
 
     env[EXIT_ON_DRIVER_EXIT_ENV] = "1"
     env[SPAWNER_PID_ENV] = str(os.getpid())
+    # cluster-wide trace epoch: every runtime process mints trace ids
+    # under the driver's epoch prefix, so ids from one cluster
+    # incarnation never collide with a restarted one's (tracing.py)
+    from ray_tpu.observability.tracing import TRACE_EPOCH_ENV, trace_epoch
+
+    env.setdefault(TRACE_EPOCH_ENV, trace_epoch())
     return env
+
+
+def _spawn_and_handshake(cmd, log_path: str, what: str) -> tuple:
+    """Spawn one runtime process (head / node daemon / standalone
+    controller) and complete the stdout handshake: every spawner shares
+    the same contract — detached session + driver-scoped env
+    (``_subprocess_env``: orphan watch, scrubbed accelerator triggers,
+    the cluster trace epoch), stderr appended to ``log_path``, and ONE
+    stdout line of JSON announcing the ports. Returns ``(proc, info)``.
+    (Third and last of the PR 5 deferred refactor trio: spawn_node and
+    spawn_controller used to duplicate all of this.)"""
+    os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+    err_f = open(log_path, "ab")
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=err_f, start_new_session=True,
+        env=_subprocess_env(),
+    )
+    line = proc.stdout.readline().decode()
+    if not line:
+        raise RuntimeError(f"{what} failed to start (see {log_path})")
+    return proc, json.loads(line)
 
 
 class ClusterBackend(CoreWorker):
@@ -62,15 +89,9 @@ class ClusterBackend(CoreWorker):
 
         cmd += ["--system-config", serialize_config()]
         os.makedirs(session_dir, exist_ok=True)
-        err_f = open(os.path.join(session_dir, "head.log"), "ab")
-        proc = subprocess.Popen(
-            cmd, stdout=subprocess.PIPE, stderr=err_f, start_new_session=True,
-            env=_subprocess_env(),
+        proc, ports = _spawn_and_handshake(
+            cmd, os.path.join(session_dir, "head.log"), "head process"
         )
-        line = proc.stdout.readline().decode()
-        if not line:
-            raise RuntimeError(f"head process failed to start (see {session_dir}/head.log)")
-        ports = json.loads(line)
         backend = cls(
             "127.0.0.1", ports["controller_port"], "127.0.0.1", ports["daemon_port"]
         )
@@ -131,16 +152,11 @@ def spawn_node(
     from ray_tpu.core.config import serialize_config
 
     cmd += ["--system-config", serialize_config()]
-    os.makedirs("/tmp/ray_tpu", exist_ok=True)
-    err_f = open(f"/tmp/ray_tpu/node-{os.getpid()}-{time.time_ns()}.log", "ab")
-    proc = subprocess.Popen(
-        cmd, stdout=subprocess.PIPE, stderr=err_f, start_new_session=True,
-        env=_subprocess_env(),
+    proc, info = _spawn_and_handshake(
+        cmd,
+        f"/tmp/ray_tpu/node-{os.getpid()}-{time.time_ns()}.log",
+        "node daemon",
     )
-    line = proc.stdout.readline().decode()
-    if not line:
-        raise RuntimeError("node daemon failed to start")
-    info = json.loads(line)
     proc.node_port = info["daemon_port"]  # type: ignore[attr-defined]
     proc.node_id_hex = info["node_id"]  # type: ignore[attr-defined]
     return proc
@@ -163,17 +179,10 @@ def spawn_controller(
         "--session-dir", session_dir, "--port", str(port),
         "--system-config", serialize_config(),
     ]
-    err_f = open(os.path.join(session_dir, "controller.log"), "ab")
-    proc = subprocess.Popen(
-        cmd, stdout=subprocess.PIPE, stderr=err_f, start_new_session=True,
-        env=_subprocess_env(),
+    proc, info = _spawn_and_handshake(
+        cmd, os.path.join(session_dir, "controller.log"), "controller"
     )
-    line = proc.stdout.readline().decode()
-    if not line:
-        raise RuntimeError(
-            f"controller failed to start (see {session_dir}/controller.log)"
-        )
-    proc.controller_port = json.loads(line)["controller_port"]  # type: ignore[attr-defined]
+    proc.controller_port = info["controller_port"]  # type: ignore[attr-defined]
     return proc
 
 

@@ -126,6 +126,16 @@ class GlobalConfig:
     log_to_driver: bool = True
     #: push task lifecycle events to the controller (state API `list tasks`)
     task_events_enabled: bool = True
+    #: distributed-tracing sample rate in [0, 1]: a fresh trace root is
+    #: sampled at request entry points (driver submit, serve router
+    #: dispatch) with this probability; children inherit the verdict
+    #: causally. 0 (default) keeps the submit hot path span-free — one
+    #: contextvar read + one float compare per submit, no allocation.
+    trace_sample_rate: float = 0.0
+    #: byte budget for worker-exported timeline event chunks retained on
+    #: the controller (observability/timeline.py): past it the OLDEST
+    #: exports are dropped; a dead node's chunks are reaped with it.
+    timeline_kv_max_bytes: int = 16 * 1024**2
     #: grace window for daemons to re-register/sync after a controller
     #: restart before unadopted restored state is rescheduled
     controller_restore_grace_s: float = 10.0

@@ -59,6 +59,11 @@ class NodeInfo:
     drain_reason: str = ""
     last_sync: float = field(default_factory=time.monotonic)
     health_failures: int = 0
+    #: latest daemon-synced shm store stats + worker/lease counts
+    #: (cluster_status's per-node object view; refreshed every sync)
+    store_stats: Dict[str, Any] = field(default_factory=dict)
+    num_workers: int = 0
+    num_leases: int = 0
 
 
 @dataclass
@@ -111,6 +116,13 @@ class Controller:
         # task-event ring buffer (``GcsTaskManager`` — serves the state
         # API's `list tasks`; workers push batched lifecycle events)
         self.task_events: "OrderedDict[bytes, Dict[str, Any]]" = OrderedDict()
+        # worker-exported timeline event chunks (observability/timeline):
+        # BOUNDED by timeline_kv_max_bytes (oldest exports dropped) and
+        # reaped per node on death — the fix for the unbounded
+        # ``ray_tpu:events:*`` KV growth the old export path had. Keyed
+        # by (exporter uid, pid, chunk), value = (node_id, blob).
+        self.timeline_exports: "OrderedDict[str, Tuple[bytes, bytes]]" = OrderedDict()
+        self._timeline_export_bytes = 0
         self._subscribers: Set[ServerConnection] = set()
         # channel → connections that asked for it (None entry = legacy
         # subscribe-to-everything); high-volume channels (logs) only go
@@ -151,6 +163,7 @@ class Controller:
             if port is None:
                 self.server.port = 0
                 port = await self.server.start()
+        self._loop = asyncio.get_event_loop()  # /federate bridges here
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self.persist_path:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
@@ -324,11 +337,63 @@ class Controller:
                 g_pgs.set(pg_states.get(state, 0), {"state": state})
 
         self._metrics_cb = on_collect(sample)
-        self._metrics_server = MetricsServer(host=GLOBAL_CONFIG.metrics_bind_host, port=GLOBAL_CONFIG.metrics_port)
+        # /federate: one scrape returns EVERY node's registry with node
+        # labels (the controller fans out to the daemons' metrics_text
+        # RPC) — point Prometheus at this instead of per-node targets
+        self._metrics_server = MetricsServer(
+            host=GLOBAL_CONFIG.metrics_bind_host,
+            port=GLOBAL_CONFIG.metrics_port,
+            routes={"/federate": self._federate_blocking},
+        )
         logger.info(
-            "controller metrics at http://127.0.0.1:%d/metrics",
+            "controller metrics at http://127.0.0.1:%d/metrics "
+            "(cluster federation at /federate)",
             self._metrics_server.port,
         )
+
+    def _federate_blocking(self) -> str:
+        """HTTP-thread bridge for /federate: run the async fan-out on
+        the controller loop and wait bounded."""
+        loop = getattr(self, "_loop", None)
+        if loop is None or not loop.is_running():
+            return ""
+        fut = asyncio.run_coroutine_threadsafe(self._federated_text(), loop)
+        return fut.result(timeout=15)
+
+    async def _federated_text(self) -> str:
+        """Every registered node's /metrics registry plus the
+        controller's own, each series stamped with a ``node`` label.
+        Duplicate HELP/TYPE comment lines are emitted once."""
+        from ray_tpu.observability.metrics import inject_label, render
+
+        loop = asyncio.get_event_loop()
+        own = await loop.run_in_executor(None, render)
+        parts = [inject_label(own, "node", "controller")]
+        items = list(self.node_clients.items())
+
+        async def one(node_id: bytes, client: RpcClient) -> str:
+            try:
+                text = await client.call("metrics_text", {}, timeout=10)
+                return inject_label(text, "node", node_id.hex()[:12])
+            except Exception:
+                return ""  # dead/slow node: omit from this scrape
+
+        parts += [
+            t
+            for t in await asyncio.gather(*[one(n, c) for n, c in items])
+            if t
+        ]
+        seen_comments: set = set()
+        out: List[str] = []
+        for text in parts:
+            for line in text.splitlines():
+                if line.startswith("#"):
+                    key = " ".join(line.split()[:3])  # "# TYPE <name>"
+                    if key in seen_comments:
+                        continue
+                    seen_comments.add(key)
+                out.append(line)
+        return "\n".join(out) + "\n"
 
     @property
     def metrics_port(self) -> int:
@@ -441,6 +506,9 @@ class Controller:
         node.available = payload["available"]
         node.total = payload.get("total", node.total)
         node.pending_leases = payload.get("pending_leases", [])
+        node.store_stats = payload.get("store", node.store_stats)
+        node.num_workers = payload.get("num_workers", node.num_workers)
+        node.num_leases = payload.get("num_leases", node.num_leases)
         node.last_sync = time.monotonic()
         node.health_failures = 0
         # adopt running actors a restored controller only knows as
@@ -589,6 +657,17 @@ class Controller:
             NODE_PUSH_CHANNEL,
             {"node_id": node.node_id, "alive": False, "state": "DEAD"},
         )
+        # Reap the dead node's timeline exports: its workers can never
+        # export again, and the ring must not carry their chunks forever
+        # (the worker-deregistration half of bounded retention).
+        stale_keys = [
+            k
+            for k, (nid, _b) in self.timeline_exports.items()
+            if nid == node.node_id
+        ]
+        for k in stale_keys:
+            _nid, blob = self.timeline_exports.pop(k)
+            self._timeline_export_bytes -= len(blob)
         # Fail over actors that lived there. A drained node's deaths are
         # not the actors' fault: their restarts consume no budget.
         for actor_id, info in list(self.actors.items()):
@@ -1102,6 +1181,103 @@ class Controller:
                 o["node_id"] = node_id.hex()
                 out.append(o)
         return out
+
+    async def c_cluster_telemetry(self, payload, conn):
+        """Federated cluster telemetry (RPC flavor of /federate): the
+        controller's own registry plus every node's, as raw exposition
+        text per source. ``federate_port`` is the HTTP port serving the
+        merged node-labeled view."""
+        from ray_tpu.observability.metrics import render
+
+        loop = asyncio.get_event_loop()
+        items = list(self.node_clients.items())
+
+        async def one(client: RpcClient):
+            try:
+                return await client.call("metrics_text", {}, timeout=10)
+            except Exception:
+                return None
+
+        texts = await asyncio.gather(*[one(c) for _nid, c in items])
+        return {
+            "controller": await loop.run_in_executor(None, render),
+            "nodes": {
+                node_id.hex(): text
+                for (node_id, _c), text in zip(items, texts)
+                if text is not None
+            },
+            "federate_port": self.metrics_port,
+        }
+
+    async def c_cluster_status(self, payload, conn):
+        """Live cluster state in one reply (the ``ray list`` equivalent)
+        from tables the controller already keeps bounded: node
+        membership, actors, a task-state summary + recent tail, per-node
+        object-store stats (refreshed by every resource sync), placement
+        groups, and jobs. Serve replicas appear in ``actors`` — replica
+        liveness is actor liveness."""
+        limit = (payload or {}).get("recent_tasks", 20)
+        task_summary: Dict[str, int] = {}
+        for ev in self.task_events.values():
+            task_summary[ev["state"]] = task_summary.get(ev["state"], 0) + 1
+        return {
+            "nodes": await self.c_nodes(None, conn),
+            "actors": await self.c_list_actors(None, conn),
+            "tasks": {
+                "summary": task_summary,
+                "recent": [
+                    dict(ev, task_id=ev["task_id"].hex())
+                    for ev in list(self.task_events.values())[-limit:]
+                ],
+            },
+            "objects": {
+                n.node_id.hex(): dict(
+                    n.store_stats,
+                    num_workers=n.num_workers,
+                    num_leases=n.num_leases,
+                )
+                for n in self.nodes.values()
+                if n.alive
+            },
+            "placement_groups": await self.c_pg_table(None, conn),
+            "jobs": [
+                {
+                    "job_id": jid.hex() if isinstance(jid, bytes) else str(jid),
+                    "start_time": info.get("start_time"),
+                    "driver_pid": info.get("driver_pid"),
+                }
+                for jid, info in self.jobs.items()
+            ],
+        }
+
+    # ---- timeline event exports (bounded; observability/timeline.py) ----
+    async def c_export_events(self, payload, conn):
+        """Worker-exported timeline chunk. Keyed by the exporter's
+        unique (uid, pid, chunk) key — a retried export overwrites its
+        own entry (idempotent). Retention: oldest chunks are dropped
+        past ``timeline_kv_max_bytes`` (a single oversized chunk is
+        kept while alone), and a node's chunks die with it."""
+        key = payload["key"]
+        if isinstance(key, bytes):
+            key = key.decode()
+        blob = payload["blob"]
+        old = self.timeline_exports.pop(key, None)
+        if old is not None:
+            self._timeline_export_bytes -= len(old[1])
+        self.timeline_exports[key] = (payload.get("node_id") or b"", blob)
+        self._timeline_export_bytes += len(blob)
+        budget = GLOBAL_CONFIG.timeline_kv_max_bytes
+        while (
+            self._timeline_export_bytes > budget
+            and len(self.timeline_exports) > 1
+        ):
+            _k, (_nid, old_blob) = self.timeline_exports.popitem(last=False)
+            self._timeline_export_bytes -= len(old_blob)
+        return True
+
+    async def c_collect_events(self, payload, conn):
+        """Driver-side ``timeline()`` pulls every retained chunk."""
+        return [blob for (_nid, blob) in self.timeline_exports.values()]
 
     # ---- kv ------------------------------------------------------------
     async def c_kv_put(self, payload, conn):

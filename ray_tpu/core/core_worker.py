@@ -58,6 +58,8 @@ from ray_tpu.core.rpc import (
     RpcServer,
 )
 from ray_tpu.core.task_spec import TaskKind, TaskSpec, encode_spec
+from ray_tpu.observability import timeline as _timeline
+from ray_tpu.observability import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -291,6 +293,30 @@ class CoreWorker(RuntimeBackend):
     # ------------------------------------------------------------------
     # objects: get
     def get_objects(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        # Tracing wrapper: a get() on a traced result (or inside a traced
+        # task) records a "get" span closing the submit → execute →
+        # result-push → get chain. The unsampled hot path pays one float
+        # compare + one contextvar read and goes straight to the inner
+        # body — no lineage lookup, no timestamping.
+        if GLOBAL_CONFIG.trace_sample_rate <= 0.0 and _tracing.current() is None:
+            return self._get_objects_inner(refs, timeout)
+        wire = _tracing.current_wire()
+        if wire is None and refs:
+            obj = self.refcounter.get(refs[0].id())
+            lineage = getattr(obj, "lineage", None)
+            wire = getattr(lineage, "trace_ctx", None)
+        if wire is None:
+            return self._get_objects_inner(refs, timeout)
+        t0_us = _timeline._now_us()
+        try:
+            return self._get_objects_inner(refs, timeout)
+        finally:
+            _tracing.record_span(
+                wire, f"get::{len(refs)}", t0_us, _timeline._now_us(),
+                category="task",
+            )
+
+    def _get_objects_inner(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
         # Sync fast path for owned refs: resolve on the CALLING thread —
         # in-process cache hits return immediately, pending results park
@@ -757,6 +783,11 @@ class CoreWorker(RuntimeBackend):
         for oid in spec.return_ids:
             self.refcounter.create_pending(oid, lineage=spec, hold=True)
         self._pin_deps(spec)
+        # tracing: inherit the ambient context or sample a fresh root
+        # (no-op + no allocation when unsampled); the stamp rides the
+        # per-call wire fields so the executor re-enters it
+        _tracing.stamp_spec(spec)
+        spec._submit_ts = time.monotonic()  # stage-histogram anchor
         self.emit_task_event(spec, "SUBMITTED")
         self._buffer_submit(False, spec)
 
@@ -877,8 +908,21 @@ class CoreWorker(RuntimeBackend):
     async def _pump_class(self, key, q: "_ClassQueue", template: TaskSpec) -> None:
         try:
             while q.specs:
+                # the lease is acquired on behalf of the request at the
+                # queue HEAD — attribute its span there, not to the spec
+                # that happened to start this pump (which may be long
+                # finished, or unsampled while the head is sampled)
+                head_trace = q.specs[0].trace_ctx if q.specs else None
+                lease_t0 = time.monotonic()
+                lease_t0_us = _timeline._now_us() if head_trace else 0.0
                 try:
                     grant = await self._acquire_lease(template)
+                    self._observe_stage("lease", time.monotonic() - lease_t0)
+                    if head_trace is not None:
+                        _tracing.record_span(
+                            head_trace, "lease", lease_t0_us,
+                            _timeline._now_us(), category="task",
+                        )
                 except RayTpuError as e:
                     # class-wide failure (infeasible / lease timeout):
                     # fail everything currently queued for this class
@@ -969,6 +1013,17 @@ class CoreWorker(RuntimeBackend):
                         spec, error=TaskCancelledError(spec.task_id.hex()[:16])
                     )
                     continue
+                submit_ts = getattr(spec, "_submit_ts", None)
+                if submit_ts is not None:
+                    # queue stage: submit → popped by a lease pump
+                    queued_s = time.monotonic() - submit_ts
+                    self._observe_stage("queue", queued_s)
+                    if spec.trace_ctx is not None:
+                        now_us = _timeline._now_us()
+                        _tracing.record_span(
+                            spec.trace_ctx, f"queue::{spec.name}",
+                            now_us - queued_s * 1e6, now_us, category="task",
+                        )
                 batch.append(spec)
             if not batch:
                 continue
@@ -980,6 +1035,9 @@ class CoreWorker(RuntimeBackend):
             grow_handle = loop.call_later(
                 GLOBAL_CONFIG.lease_pump_growth_s, self._maybe_grow_pumps, key, q
             )
+            push_t0 = time.monotonic()
+            traced = next((s for s in batch if s.trace_ctx is not None), None)
+            push_t0_us = _timeline._now_us() if traced is not None else 0.0
             try:
                 reply = await worker_client.call(
                     "push_batch",
@@ -1030,6 +1088,15 @@ class CoreWorker(RuntimeBackend):
                 grow_handle.cancel()
                 for spec in batch:
                     self._inflight_workers.pop(spec.task_id.binary(), None)
+            # push stage: the whole batch's RPC round trip (execution
+            # included); one span per batch — per-spec copies of the
+            # same interval would only add noise to the trace
+            self._observe_stage("push", time.monotonic() - push_t0)
+            if traced is not None:
+                _tracing.record_span(
+                    traced.trace_ctx, f"push_batch::{len(batch)}",
+                    push_t0_us, _timeline._now_us(), category="task",
+                )
             replies = reply["replies"]
             for i, spec in enumerate(batch):
                 if i >= len(replies):
@@ -1061,6 +1128,12 @@ class CoreWorker(RuntimeBackend):
                 return True
         return False
 
+    @staticmethod
+    def _observe_stage(stage: str, seconds: float) -> None:
+        from ray_tpu.observability.rpc_metrics import TASK_STAGE_SECONDS
+
+        TASK_STAGE_SECONDS.observe(seconds, labels={"stage": stage})
+
     def _finalize_spec(self, spec: TaskSpec, error: Optional[Exception] = None) -> None:
         """A spec leaves the submission system: record failure (if any),
         release dep pins and cancellation/retry bookkeeping."""
@@ -1070,6 +1143,16 @@ class CoreWorker(RuntimeBackend):
         self._cancelled_tasks.pop(tid, None)
         self._retries_left.pop(tid, None)
         self._unpin_deps(spec)
+        submit_ts = getattr(spec, "_submit_ts", None)
+        if submit_ts is not None:
+            self._observe_stage("total", time.monotonic() - submit_ts)
+        if spec.trace_ctx is not None and error is None:
+            # result-push landed at the owner: instant completion marker
+            now_us = _timeline._now_us()
+            _tracing.record_span(
+                spec.trace_ctx, f"complete::{spec.name}", now_us, now_us,
+                category="task",
+            )
         self.emit_task_event(spec, "FAILED" if error is not None else "FINISHED")
 
     # ------------------------------------------------------------------
@@ -1356,6 +1439,7 @@ class CoreWorker(RuntimeBackend):
     # ------------------------------------------------------------------
     # actors
     def create_actor(self, spec: TaskSpec) -> None:
+        _tracing.stamp_spec(spec)
         with self._actors_lock:
             st = self._actors.setdefault(spec.actor_id, _ActorState())
             st.max_task_retries = spec.max_task_retries
@@ -1450,6 +1534,8 @@ class CoreWorker(RuntimeBackend):
         for oid in spec.return_ids:
             self.refcounter.create_pending(oid, hold=True)
         self._pin_deps(spec)
+        _tracing.stamp_spec(spec)
+        spec._submit_ts = time.monotonic()
         self._buffer_submit(True, spec)
 
     def _enqueue_actor_task(self, spec: TaskSpec) -> None:
@@ -1907,6 +1993,36 @@ class CoreWorker(RuntimeBackend):
 
     def kv_del(self, key: bytes) -> None:
         self.io.run(self.controller.call("kv_del", {"key": key}))
+
+    # ------------------------------------------------------------------
+    # timeline export: worker-side chunks land in the controller's
+    # BOUNDED export table (byte budget + node-death reap) instead of
+    # growing the generic KV forever (observability/timeline.py)
+    def export_timeline_chunk(self, key: str, blob: bytes) -> None:
+        try:
+            self.io.run(
+                self.controller.call(
+                    "export_events",
+                    {"key": key, "blob": blob, "node_id": self.node_id},
+                    timeout=10,
+                )
+            )
+        except Exception:
+            pass  # observability export is best-effort
+
+    def collect_timeline_chunks(self) -> List[bytes]:
+        try:
+            return self.io.run(
+                self.controller.call("collect_events", {}, timeout=30)
+            )
+        except Exception:
+            return []
+
+    def cluster_status(self) -> Dict[str, Any]:
+        """Live cluster state in one call (the `ray list` equivalent):
+        nodes / actors / task summary / per-node object stats / PGs /
+        jobs, served from the controller's bounded tables."""
+        return self.io.run(self.controller.call("cluster_status", {}, timeout=30))
 
     def cluster_resources(self) -> Dict[str, float]:
         return self.io.run(self.controller.call("cluster_resources"))

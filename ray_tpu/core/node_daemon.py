@@ -557,6 +557,11 @@ class NodeDaemon:
                         "node_id": self.node_id.binary(),
                         "available": self.resources.available.to_dict(),
                         "total": self.resources.total.to_dict(),
+                        # store + worker counters: what cluster_status()
+                        # reports per node without a fan-out RPC
+                        "store": self.store.stats(),
+                        "num_workers": len(self.workers),
+                        "num_leases": len(self.leases),
                         # parked lease shapes: task demand for the
                         # autoscaler's bin-packing
                         "pending_leases": list(self._waiting_leases.values()),
@@ -1292,3 +1297,15 @@ class NodeDaemon:
         from ray_tpu.observability.event_stats import debug_snapshot
 
         return debug_snapshot()
+
+    async def d_metrics_text(self, payload, conn):
+        """This daemon's full Prometheus registry as exposition text —
+        the controller's federation scrape (``c_cluster_telemetry``)
+        aggregates every node's registry with ``node`` labels from here,
+        so one scrape of the controller sees the whole cluster."""
+        from ray_tpu.observability.metrics import render
+
+        loop = asyncio.get_event_loop()
+        # render() runs collect callbacks (store stats etc.) — keep the
+        # lock-taking text assembly off the daemon's event loop
+        return await loop.run_in_executor(None, render)

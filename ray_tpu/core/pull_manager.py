@@ -44,6 +44,7 @@ import asyncio
 import logging
 import os
 import threading
+import time
 import zlib
 from collections import deque
 from typing import Deque, Dict, Optional, Tuple
@@ -54,8 +55,15 @@ from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_store import ShmStore, _attach
 from ray_tpu.core.rpc import ConnectionLost
 from ray_tpu.core.transport_retry import backoff_sleep
+from ray_tpu.observability import tracing as _tracing
 
 logger = logging.getLogger(__name__)
+
+
+def _observe_pull_stage(stage: str, seconds: float) -> None:
+    from ray_tpu.observability.rpc_metrics import PULL_STAGE_SECONDS
+
+    PULL_STAGE_SECONDS.observe(seconds, labels={"stage": stage})
 
 _Source = Tuple[str, int]
 
@@ -184,9 +192,16 @@ class PullManager:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._inflight[object_id] = fut
         result = None
+        t0 = time.monotonic()
         try:
             try:
-                result = await self._pull(object_id, sources)
+                # the span records only when the pull RPC carried a
+                # sampled trace (rpc._dispatch re-entered it); the stage
+                # histogram always observes
+                with _tracing.span(
+                    f"pull::{object_id.hex()[:12]}", "data"
+                ):
+                    result = await self._pull(object_id, sources)
             except Exception as e:  # noqa: BLE001 — waiters need a result
                 logger.exception("pull of %s crashed", object_id.hex()[:12])
                 result = {
@@ -195,6 +210,7 @@ class PullManager:
                     "causes": {"internal": repr(e)},
                 }
         finally:
+            _observe_pull_stage("total", time.monotonic() - t0)
             # resolve waiters even if the runner was CANCELLED (daemon
             # stopping) — coalesced pulls must never park forever
             self._inflight.pop(object_id, None)
@@ -336,8 +352,10 @@ class PullManager:
         candidates: Deque[_Source] = deque(
             dict.fromkeys(tuple(s) for s in sources)
         )
+        probe_t0 = time.monotonic()
         try:
             src, head = await self._probe(candidates, object_id, causes)
+            _observe_pull_stage("probe", time.monotonic() - probe_t0)
         except _PullAbort as e:
             PULL_FAILURES.inc()
             causes.setdefault("deadline" if e.deadline else "abort", str(e))
@@ -363,7 +381,9 @@ class PullManager:
         allocated = False
         seg = None
         try:
+            admit_t0 = time.monotonic()
             await self._admit(size)
+            _observe_pull_stage("admit", time.monotonic() - admit_t0)
             admitted = True
             # re-check after (possibly) queueing: a local put or adopt
             # may have landed while we were parked
@@ -379,6 +399,7 @@ class PullManager:
             seg = _attach(name)
             buf = seg.buf
             offset, crc = 0, 0
+            transfer_t0 = time.monotonic()
             while True:
                 try:
                     offset, crc = await self._stream_from(
@@ -411,6 +432,7 @@ class PullManager:
                     src, offset, crc = nxt, 0, 0  # restart clean
                     continue
                 break
+            _observe_pull_stage("transfer", time.monotonic() - transfer_t0)
             self.store.seal_receive(object_id, digest=crc)
             meta = self.store.ensure_local(object_id)
             return {"segment": meta[0], "size": meta[1]}

@@ -52,6 +52,7 @@ from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 import msgpack
 
 from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.observability import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -89,7 +90,12 @@ IDEMPOTENT_METHODS: Dict[str, frozenset] = {
             "autoscaler_demand", "kv_get", "kv_keys", "get_actor_info",
             "get_named_actor", "list_named_actors", "get_pg",
             "get_named_pg", "pg_table", "list_tasks", "list_actors",
-            "list_objects", "get_relocated",
+            "list_objects", "get_relocated", "cluster_status",
+            "cluster_telemetry", "collect_events",
+            # idempotent-by-construction: timeline export chunks are
+            # keyed by (exporter, pid, chunk) — a retried export
+            # overwrites its own entry
+            "export_events",
             # idempotently guarded (DRAINING is a terminal latch)
             "drain_node",
         }
@@ -97,7 +103,7 @@ IDEMPOTENT_METHODS: Dict[str, frozenset] = {
     # node daemons (core/node_daemon.py, d_* handlers)
     "noded": frozenset(
         {
-            "ping", "hello", "event_stats", "stats",
+            "ping", "hello", "event_stats", "stats", "metrics_text",
             # pure reads over the object directory/store. fetch_chunk /
             # object_info / get_object_meta MUST stay here: dedup-stamped
             # replies enter the bounded reply cache, and one multi-MiB
@@ -415,8 +421,13 @@ class RpcServer:
                 elif mode == "reply_drop":
                     reply_drop = True
             # --- request dedup (exactly-once-effective) ---------------
+            # meta slots: [client_id, request_id, trace_ctx?]. A zero
+            # request id is the "trace only, no dedup" sentinel (real
+            # ids start at 1) — idempotent methods under an active
+            # trace still carry the context without entering the cache.
+            trace_wire = meta[2] if meta is not None and len(meta) > 2 else None
             dedup_key = None
-            if meta is not None:
+            if meta is not None and meta[1]:
                 dedup_key = (bytes(meta[0]), meta[1])
                 record = self._dedup_done.get(dedup_key)
                 if record is None:
@@ -444,7 +455,16 @@ class RpcServer:
             try:
                 try:
                     arg = pickle.loads(payload) if payload else None
-                    result = await handler(arg, conn)
+                    if trace_wire:
+                        # sampled caller: run the handler inside its
+                        # trace so server-side spans (and nested calls)
+                        # parent to the sender's span
+                        with _tracing.scope(trace_wire), _tracing.span(
+                            f"rpc::{method_name}", "rpc"
+                        ):
+                            result = await handler(arg, conn)
+                    else:
+                        result = await handler(arg, conn)
                     record = (REPLY_OK, pickle.dumps(result, protocol=5))
                 except Exception as e:  # noqa: BLE001 — reply with the error
                     # the handler RAN (or its arguments were undecodable):
@@ -827,12 +847,23 @@ class RpcClient:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[seq] = fut
         try:
+            # meta = [client_id, request_id, trace_ctx?]: request_id 0 is
+            # the trace-only sentinel (no dedup); untraced calls without
+            # a request id stay meta-less — the unsampled wire format is
+            # byte-identical to before tracing existed
+            trace = _tracing.current_wire()
+            if request_id is None and trace is None:
+                meta = None
+            else:
+                meta = [self.client_id, request_id or 0]
+                if trace is not None:
+                    meta.append(list(trace))
             body = _encode_body(
                 REQUEST,
                 seq,
                 method.encode(),
                 pickle.dumps(payload, protocol=5),
-                None if request_id is None else [self.client_id, request_id],
+                meta,
             )
             self._out.append(body)
             self._out_bytes = getattr(self, "_out_bytes", 0) + len(body)
@@ -915,7 +946,13 @@ class IoThread:
         self.loop.run_forever()
 
     def run(self, coro, timeout: Optional[float] = None):
-        """Run a coroutine on the io loop from a sync context."""
+        """Run a coroutine on the io loop from a sync context. The
+        caller thread's ambient trace (if any) is re-entered around the
+        coroutine — run_coroutine_threadsafe does not carry contextvars,
+        and RPCs issued for a traced request must stamp its context."""
+        wire = _tracing.current_wire()
+        if wire is not None:
+            coro = _tracing.carry(coro, wire)
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 

@@ -370,6 +370,7 @@ class TaskExecutor:
             # otherwise mint colliding ObjectIDs (shm segments are named by
             # ObjectID, so a collision silently overwrites data).
             from ray_tpu.core.deadline import deadline_scope
+            from ray_tpu.observability import tracing as _tracing
 
             self.api_worker.job_id = spec.job_id
             self.api_worker.set_task_context(spec.task_id, spec.job_id)
@@ -377,6 +378,14 @@ class TaskExecutor:
                 self._async_sem = asyncio.Semaphore(max(1, self._max_concurrency))
             async with self._async_sem:
                 with deadline_scope(spec.deadline_remaining_s):
+                    if spec.trace_ctx is not None:
+                        # async actor methods (e.g. serve replicas) get
+                        # the same causal re-entry as lane-thread tasks
+                        with _tracing.scope(spec.trace_ctx), _tracing.span(
+                            f"task::{spec.name}", "task",
+                            task_id=spec.task_id.hex()[:16],
+                        ):
+                            return await method(*args, **kwargs)
                     return await method(*args, **kwargs)
 
         cfut = asyncio.run_coroutine_threadsafe(_run(), self._async_loop)
@@ -398,22 +407,41 @@ class TaskExecutor:
         """Runs on a lane thread. Returns packaged results."""
         from ray_tpu.core.deadline import deadline_scope
         from ray_tpu.observability import timeline as _timeline
+        from ray_tpu.observability import tracing as _tracing
+        from ray_tpu.observability.rpc_metrics import TASK_STAGE_SECONDS
 
         _start_us = _timeline._now_us()
         try:
             # re-enter the submitter's remaining budget: nested get()/wait()
             # inside this task inherit the caller's deadline (deadline
-            # propagation, hang defense)
+            # propagation, hang defense). Traced specs additionally
+            # re-enter the submitter's TRACE: this task's span parents to
+            # the caller's, and everything nested under it (submits of
+            # child tasks, actor calls, RPCs) parents to this task's span
+            # — the cross-process causal chain.
+            if spec.trace_ctx is not None:
+                with _tracing.scope(spec.trace_ctx), _tracing.span(
+                    f"task::{spec.name}", "task",
+                    task_id=spec.task_id.hex()[:16],
+                ), deadline_scope(spec.deadline_remaining_s):
+                    return self._execute_inner(spec, emit)
             with deadline_scope(spec.deadline_remaining_s):
                 return self._execute_inner(spec, emit)
         finally:
-            _timeline.record_event(
-                f"task::{spec.name}",
-                "task",
-                _start_us,
-                _timeline._now_us(),
-                args={"task_id": spec.task_id.hex()[:16]},
+            end_us = _timeline._now_us()
+            TASK_STAGE_SECONDS.observe(
+                (end_us - _start_us) / 1e6, labels={"stage": "execute"}
             )
+            if spec.trace_ctx is None:
+                # traced specs already recorded their span above — one
+                # event per execution either way
+                _timeline.record_event(
+                    f"task::{spec.name}",
+                    "task",
+                    _start_us,
+                    end_us,
+                    args={"task_id": spec.task_id.hex()[:16]},
+                )
 
     def _apply_runtime_env(self, spec: TaskSpec):
         """Minimal runtime-env support (reference

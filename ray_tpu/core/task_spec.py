@@ -149,6 +149,13 @@ class TaskSpec:
     method_name: Optional[str] = None
     seq_no: int = 0
     concurrency_group: Optional[str] = None
+    # Distributed-tracing context (observability/tracing.py): the
+    # submitter's (trace_id, span_id) pair, stamped per call the same
+    # way deadline_remaining_s is — the executing worker re-enters the
+    # context so its spans (and nested submits) parent to the caller's.
+    # None = untraced (the sampling-off default; zero wire overhead
+    # beyond one tuple slot).
+    trace_ctx: Optional[Tuple[str, str]] = None
     # Set when this spec was spliced from a cached SpecTemplate: the
     # submit path ships (template_id, per-call fields) instead of the
     # full spec — executors rebuild it from their template cache.
@@ -195,6 +202,7 @@ class SpecTemplate:
         return_ids: List[ObjectID],
         deadline_remaining_s: Optional[float] = None,
         seq_no: int = 0,
+        trace_ctx: Optional[Tuple[str, str]] = None,
     ) -> TaskSpec:
         """Splice per-call fields into a full TaskSpec. Invariant fields
         are SHARED (same dict/strategy objects across calls) — nothing
@@ -221,6 +229,7 @@ class SpecTemplate:
             method_name=self.method_name,
             seq_no=seq_no,
             concurrency_group=self.concurrency_group,
+            trace_ctx=trace_ctx,
             template_id=self.template_id,
         )
 
@@ -232,6 +241,7 @@ class SpecTemplate:
             [ObjectID(b) for b in pc[3]],
             deadline_remaining_s=pc[4],
             seq_no=pc[5],
+            trace_ctx=pc[6] if len(pc) > 6 else None,
         )
 
 
@@ -251,5 +261,6 @@ def encode_spec(spec: TaskSpec):
             [o.binary() for o in spec.return_ids],
             spec.deadline_remaining_s,
             spec.seq_no,
+            spec.trace_ctx,
         ),
     )

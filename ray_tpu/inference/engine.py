@@ -47,6 +47,7 @@ from ray_tpu.inference.scheduler import (
     Request,
 )
 from ray_tpu.observability import timeline
+from ray_tpu.observability import tracing as _tracing
 
 _END = object()  # stream sentinel
 
@@ -214,6 +215,10 @@ class InferenceEngine:
         )
         self._out: Dict[str, queue.Queue] = {}
         self._rngs: Dict[str, np.random.RandomState] = {}
+        # request id -> submitter's (trace_id, span_id): the step-loop
+        # thread stamps per-request spans (admission→first-token,
+        # admission→finish) under the serve caller's trace
+        self._trace_ctx: Dict[str, tuple] = {}
         self._submitted_at: Dict[str, float] = {}
         self._first_token_at: Dict[str, float] = {}
         self._finished_at: Dict[str, float] = {}
@@ -319,6 +324,7 @@ class InferenceEngine:
             deadline=Deadline.after(budget) if budget is not None else None,
             seed=seed,
         )
+        trace_wire = _tracing.current_wire()
         with self._lock:
             if rid in self._out:
                 raise ValueError(f"duplicate request_id {rid!r}")
@@ -327,6 +333,8 @@ class InferenceEngine:
                 self._rngs[rid] = np.random.RandomState(
                     seed if seed is not None else (hash(rid) & 0x7FFFFFFF)
                 )
+            if trace_wire is not None:
+                self._trace_ctx[rid] = trace_wire
             self._submitted_at[rid] = time.monotonic()
         try:
             self.scheduler.add(req)
@@ -334,6 +342,7 @@ class InferenceEngine:
             with self._lock:
                 self._out.pop(rid, None)
                 self._rngs.pop(rid, None)
+                self._trace_ctx.pop(rid, None)
                 self._submitted_at.pop(rid, None)
             raise
         self._work.set()
@@ -565,6 +574,7 @@ class InferenceEngine:
         now = time.monotonic()
         self._token_times.append(now)
         self.metrics["tokens_total"].inc()
+        first_span: Optional[tuple] = None
         with self._lock:
             q = self._out.get(req.request_id)
             if req.request_id not in self._first_token_at:
@@ -572,6 +582,20 @@ class InferenceEngine:
                 sub = self._submitted_at.get(req.request_id)
                 if sub is not None:
                     self._ttfts.append(now - sub)
+                    wire = self._trace_ctx.get(req.request_id)
+                    if wire is not None:
+                        first_span = (wire, now - sub)
+        if first_span is not None:
+            # TTFT span under the caller's trace: engine admission +
+            # queue + prefill chunks up to the first sampled token
+            end_us = timeline._now_us()
+            _tracing.record_span(
+                first_span[0], "llm_first_token",
+                end_us - first_span[1] * 1e6, end_us, category="inference",
+                request_id=req.request_id,
+                prompt_tokens=len(req.prompt),
+                cached_prefix_tokens=req.cached_prefix_tokens,
+            )
         if q is not None:
             q.put(token)
         done = (
@@ -593,18 +617,33 @@ class InferenceEngine:
             self._finish_request(req, FINISHED, error=None)
 
     def _finish_request(self, req: Request, state: str, error: Optional[Exception]) -> None:
+        outcome = {FINISHED: "finished", CANCELLED: "cancelled"}.get(state, "failed")
         with self._lock:
             q = self._out.get(req.request_id)
-            self._submitted_at.pop(req.request_id, None)
+            submitted = self._submitted_at.pop(req.request_id, None)
+            wire = self._trace_ctx.pop(req.request_id, None)
             self._rngs.pop(req.request_id, None)
             self._first_token_at.pop(req.request_id, None)
             if q is not None:
                 # the queue stays for a late tokens() call; stamp it so an
                 # abandoned stream is reaped instead of pinned forever
                 self._finished_at[req.request_id] = time.monotonic()
+        if wire is not None and submitted is not None:
+            # whole-request span under the caller's trace: admission
+            # through the last decode step (covers every prefill chunk
+            # and decode token the step loop ran for this request)
+            end_us = timeline._now_us()
+            _tracing.record_span(
+                wire, "llm_request",
+                end_us - (time.monotonic() - submitted) * 1e6, end_us,
+                category="inference",
+                request_id=req.request_id,
+                outcome=outcome,
+                generated_tokens=len(req.generated),
+                preemptions=req.preemptions,
+            )
         if q is not None:
             q.put(error if error is not None else _END)
-        outcome = {FINISHED: "finished", CANCELLED: "cancelled"}.get(state, "failed")
         self.metrics["requests_total"].inc(labels={"outcome": outcome})
 
     def _fail_all(self, error: Exception) -> None:

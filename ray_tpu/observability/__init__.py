@@ -22,4 +22,14 @@ __all__ = [
     "profile",
     "record_event",
     "timeline_events",
+    "tracing",
 ]
+
+
+def __getattr__(name: str):
+    # lazy: tracing pulls in core.config/ids — load on first touch
+    if name == "tracing":
+        import importlib
+
+        return importlib.import_module("ray_tpu.observability.tracing")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -81,6 +81,76 @@ class Gauge(Metric):
     TYPE = "gauge"
 
 
+#: default latency buckets (seconds): sub-ms submit stages through
+#: multi-second transfers — the envelopes this runtime actually spans
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Histogram(Metric):
+    """Prometheus histogram: cumulative ``_bucket{le=...}`` series plus
+    ``_sum``/``_count``. ``observe`` is a short lock + linear bucket scan
+    (≤ ~16 comparisons) — cheap enough for per-task stage timings."""
+
+    TYPE = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        labelnames: Tuple[str, ...] = (),
+        buckets: Optional[Tuple[float, ...]] = None,
+    ):
+        self.buckets = tuple(sorted(buckets or _DEFAULT_BUCKETS))
+        super().__init__(name, description, labelnames)
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        value = float(value)
+        with self._lock:
+            k = self._key(labels)
+            ent = self._values.get(k)
+            if ent is None:
+                # [per-bucket counts..., +Inf count, sum, count]
+                ent = self._values[k] = [0] * (len(self.buckets) + 1) + [0.0, 0]
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    ent[i] += 1
+                    break
+            else:
+                ent[len(self.buckets)] += 1
+            ent[-2] += value
+            ent[-1] += 1
+
+    def collect(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.description}",
+            f"# TYPE {self.name} {self.TYPE}",
+        ]
+        with self._lock:
+            for key, ent in sorted(self._values.items()):
+                base = list(zip(self.labelnames, key))
+
+                def _fmt(extra: List[Tuple[str, str]]) -> str:
+                    pairs = base + extra
+                    if not pairs:
+                        return ""
+                    return "{" + ",".join(f'{n}="{v}"' for n, v in pairs) + "}"
+
+                cum = 0
+                for i, b in enumerate(self.buckets):
+                    cum += ent[i]
+                    lines.append(
+                        f"{self.name}_bucket{_fmt([('le', repr(float(b)))])} {cum}"
+                    )
+                cum += ent[len(self.buckets)]
+                lines.append(f"{self.name}_bucket{_fmt([('le', '+Inf')])} {cum}")
+                lines.append(f"{self.name}_sum{_fmt([])} {ent[-2]}")
+                lines.append(f"{self.name}_count{_fmt([])} {ent[-1]}")
+        return lines
+
+
 def on_collect(cb: Callable[[], None]) -> Callable[[], None]:
     """Register a callback run right before exposition (for gauges
     sampled from live state, e.g. store bytes). Returns ``cb`` so the
@@ -113,18 +183,59 @@ def render() -> str:
     return "\n".join(out) + "\n"
 
 
+def inject_label(text: str, label: str, value: str) -> str:
+    """Rewrite Prometheus exposition text so every series carries
+    ``label="value"`` (federation relabeling: the controller stamps each
+    scraped node's series with its node id). Comment lines pass through.
+    A series that ALREADY carries the label keeps its own value —
+    daemon-side gauges are registered with a ``node`` label, and a
+    duplicated label name is a parse error for real Prometheus."""
+    out: List[str] = []
+    pair = f'{label}="{value}"'
+    marker = f'{label}="'
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        name, _, rest = line.partition(" ")
+        if "{" in name:
+            head, _, tail = name.partition("{")
+            # boundary-anchored so a label NAMED e.g. "mynode" doesn't
+            # mask the injection (labels are comma-joined, no spaces)
+            if tail.startswith(marker) or ("," + marker) in tail:
+                out.append(line)  # series already carries the label
+            else:
+                out.append(f"{head}{{{pair},{tail} {rest}")
+        else:
+            out.append(f"{name}{{{pair}}} {rest}")
+    return "\n".join(out)
+
+
 class _Handler(BaseHTTPRequestHandler):
+    #: extra GET routes (path -> () -> str), set per server instance via
+    #: a subclass — the controller mounts /federate here
+    _routes: Dict[str, Callable[[], str]] = {}
+
     def do_GET(self):  # noqa: N802
-        if self.path.rstrip("/") not in ("", "/metrics", "/healthz"):
+        path = self.path.rstrip("/")
+        if path in self._routes:
+            try:
+                body = self._routes[path]().encode()
+            except Exception:
+                self.send_response(500)
+                self.end_headers()
+                return
+            ctype = "text/plain; version=0.0.4"
+        elif path == "/healthz":
+            body = b"ok"
+            ctype = "text/plain"
+        elif path in ("", "/metrics"):
+            body = render().encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
             self.send_response(404)
             self.end_headers()
             return
-        if self.path.rstrip("/") == "/healthz":
-            body = b"ok"
-            ctype = "text/plain"
-        else:
-            body = render().encode()
-            ctype = "text/plain; version=0.0.4"
         self.send_response(200)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
@@ -138,14 +249,22 @@ class _Handler(BaseHTTPRequestHandler):
 class MetricsServer:
     """Prometheus exposition endpoint for this process."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        routes: Optional[Dict[str, Callable[[], str]]] = None,
+    ):
+        # per-instance handler class: co-hosted servers (controller +
+        # daemon in the head process) must not share extra routes
+        handler = type("_BoundHandler", (_Handler,), {"_routes": dict(routes or {})})
         try:
-            self._httpd = ThreadingHTTPServer((host, port), _Handler)
+            self._httpd = ThreadingHTTPServer((host, port), handler)
         except OSError:
             # fixed port already taken (e.g. controller + daemon
             # co-hosted): fall back to auto-assign rather than failing
             # cluster startup
-            self._httpd = ThreadingHTTPServer((host, 0), _Handler)
+            self._httpd = ThreadingHTTPServer((host, 0), handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="metrics"

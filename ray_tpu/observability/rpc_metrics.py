@@ -11,7 +11,7 @@ them for free.
 
 from __future__ import annotations
 
-from ray_tpu.observability.metrics import Counter, Gauge
+from ray_tpu.observability.metrics import Counter, Gauge, Histogram
 
 #: client-side RPC retry attempts (one inc per re-sent attempt)
 RPC_RETRIES = Counter(
@@ -99,6 +99,32 @@ PULL_INFLIGHT_BYTES = Gauge(
 PULL_QUEUED_BYTES = Gauge(
     "raytpu_pull_queued_bytes",
     "bytes of object transfers queued behind the admission budget",
+)
+
+# -- per-stage latency envelopes --------------------------------------------
+# The measured (not inferred) scheduler and pull-manager envelopes: where
+# a task's (or transfer's) time actually goes, as Prometheus histograms.
+# Observed on the process DOING the stage: task stages land in the owner
+# (queue/lease/push/total) and the executing worker (execute); pull
+# stages land in the pulling daemon, so they federate with node labels.
+
+#: normal-task submission stages (seconds): queue = submit→popped by a
+#: lease pump; lease = worker-lease acquisition; push = push RPC round
+#: trip (execution included); execute = worker-side run; total =
+#: submit→finalize including retries
+TASK_STAGE_SECONDS = Histogram(
+    "raytpu_task_stage_seconds",
+    "task lifecycle stage latency (queue/lease/push/execute/total)",
+    ("stage",),
+)
+
+#: object-transfer stages (seconds): admit = admission-queue wait;
+#: probe = transfer-head probe (object_info); transfer = chunk
+#: streaming incl. retries/failover; total = whole pull
+PULL_STAGE_SECONDS = Histogram(
+    "raytpu_pull_stage_seconds",
+    "pull-manager stage latency (admit/probe/transfer/total)",
+    ("stage",),
 )
 
 # -- serve router decisions (serve/router.py) -------------------------------

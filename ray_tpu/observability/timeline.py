@@ -4,9 +4,13 @@ Reference: ``ray.timeline`` (``python/ray/_private/profiling.py:124``,
 ``_private/state.py:948``) — emits chrome-tracing JSON of task lifecycle
 events. Redesigned single-file equivalent: every process records
 ``ProfileEvent``s into a bounded in-memory ring buffer; the driver dumps
-its own buffer plus any events workers exported through the controller KV
-(``ray_tpu:events:<worker>`` keys) into one chrome-trace file loadable in
-chrome://tracing or Perfetto.
+its own buffer plus any chunks workers exported to the controller's
+BOUNDED export table (``export_events``/``collect_events`` RPCs —
+byte-budgeted, reaped on node death; legacy/local backends fall back to
+the raw KV prefix path) into one chrome-trace file loadable in
+chrome://tracing or Perfetto. Events whose args carry trace ids
+(``observability/tracing.py``) additionally yield flow events — the
+cross-process causal arrows.
 """
 
 from __future__ import annotations
@@ -94,8 +98,11 @@ _export_chunk = 0
 
 
 def _collect_remote_events() -> List[ProfileEvent]:
-    """Pull worker-exported event chunks from the controller KV (prefix
-    scan — no shared index, so concurrent exporters can't race)."""
+    """Pull worker-exported event chunks. Cluster backends serve them
+    from the controller's BOUNDED export table (``collect_events`` RPC —
+    oldest chunks past ``timeline_kv_max_bytes`` are dropped, a dead
+    node's chunks are reaped with it); legacy/local backends fall back
+    to the old KV prefix scan."""
     out: List[ProfileEvent] = []
     try:
         from ray_tpu.core import api
@@ -104,8 +111,14 @@ def _collect_remote_events() -> List[ProfileEvent]:
         if worker is None:
             return out
         backend = worker.backend
-        for key in backend.kv_keys(_EVENTS_KV_PREFIX):
-            blob = backend.kv_get(key)
+        collect = getattr(backend, "collect_timeline_chunks", None)
+        if collect is not None:
+            blobs = collect()
+        else:
+            blobs = [
+                backend.kv_get(key) for key in backend.kv_keys(_EVENTS_KV_PREFIX)
+            ]
+        for blob in blobs:
             if blob:
                 for d in json.loads(blob):
                     out.append(ProfileEvent(**d))
@@ -117,7 +130,9 @@ def _collect_remote_events() -> List[ProfileEvent]:
 def export_events_to_kv() -> None:
     """Worker-side: publish NEW events (since the last export) as one
     immutable chunk under a per-process key — writes are O(delta), and no
-    cross-process read-modify-write exists anywhere."""
+    cross-process read-modify-write exists anywhere. Retention is the
+    CONTROLLER's job (bounded byte budget + node-death reap); legacy/
+    local backends without the export RPC keep the raw KV path."""
     global _export_count, _export_chunk
     from ray_tpu.core import api
 
@@ -132,9 +147,14 @@ def export_events_to_kv() -> None:
         return
     # Key on (startup-unique uuid, pid): bare pids collide across nodes in
     # a multi-node cluster and one worker's chunks would overwrite another's.
-    key = f"ray_tpu:events:{_exporter_uid}:{os.getpid()}:{_export_chunk:06d}"
+    key = f"{_exporter_uid}:{os.getpid()}:{_export_chunk:06d}"
     _export_chunk += 1
-    worker.backend.kv_put(key.encode(), json.dumps([ev.__dict__ for ev in fresh]).encode())
+    blob = json.dumps([ev.__dict__ for ev in fresh]).encode()
+    export = getattr(worker.backend, "export_timeline_chunk", None)
+    if export is not None:
+        export(key, blob)
+    else:
+        worker.backend.kv_put(_EVENTS_KV_PREFIX + key.encode(), blob)
 
 
 def start_export_thread(period_s: float = 2.0) -> threading.Thread:
@@ -155,11 +175,47 @@ def start_export_thread(period_s: float = 2.0) -> threading.Thread:
     return t
 
 
+def _flow_events(events: List[ProfileEvent]) -> List[Dict[str, Any]]:
+    """Chrome-trace flow events for every resolvable trace edge: spans
+    (events whose args carry ``span_id``) are indexed, and each child's
+    ``parent_span_id`` found in the index yields an ``s``/``f`` pair —
+    the arrows Perfetto draws from the parent's slice (any process) to
+    the child's. Unresolvable parents (not exported yet) are skipped."""
+    by_span: Dict[str, ProfileEvent] = {}
+    for ev in events:
+        sid = (ev.args or {}).get("span_id")
+        if sid:
+            by_span[sid] = ev
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        args = ev.args or {}
+        parent_id = args.get("parent_span_id")
+        sid = args.get("span_id")
+        if not parent_id or not sid:
+            continue
+        parent = by_span.get(parent_id)
+        if parent is None:
+            continue
+        flow_id = int(sid[:12], 16)
+        common = {"name": "trace", "cat": "trace", "id": flow_id}
+        # start binds to the parent's slice, finish ("e" = enclosing
+        # slice) to the child's — ts must fall inside each slice
+        out.append(
+            dict(common, ph="s", ts=parent.start_us, pid=parent.pid, tid=parent.tid)
+        )
+        out.append(
+            dict(common, ph="f", bp="e", ts=ev.start_us, pid=ev.pid, tid=ev.tid)
+        )
+    return out
+
+
 def dump_timeline(filename: Optional[str] = None) -> Any:
-    """Dump chrome://tracing JSON. Returns the trace list (and writes
-    ``filename`` if given) — matches ``ray.timeline`` semantics."""
+    """Dump chrome://tracing JSON (slices + trace flow arrows). Returns
+    the trace list (and writes ``filename`` if given) — matches
+    ``ray.timeline`` semantics; load in Perfetto / chrome://tracing."""
+    events = timeline_events() + _collect_remote_events()
     trace = []
-    for ev in timeline_events() + _collect_remote_events():
+    for ev in events:
         trace.append(
             {
                 "name": ev.name,
@@ -172,6 +228,7 @@ def dump_timeline(filename: Optional[str] = None) -> Any:
                 "args": ev.args or {},
             }
         )
+    trace.extend(_flow_events(events))
     trace.sort(key=lambda e: e["ts"])
     if filename:
         with open(filename, "w") as f:

@@ -152,7 +152,18 @@ class DeploymentHandle:
         return call
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name,))
+        # Carry the controller's ACTOR HANDLE, not just the name: a
+        # handle deserialized inside a worker (namespace "") cannot find
+        # the named controller registered under the driver's namespace —
+        # name-only reconstruction silently created a SECOND, empty
+        # serve controller and every call failed with "no replicas".
+        # The router (and its long-poll thread) is rebuilt lazily; the
+        # multiplexed model id survives via the state dict.
+        return (
+            DeploymentHandle,
+            (self._name, self._controller),
+            {"_model_id": self._model_id},
+        )
 
 
 def run(app: Application, *, name: Optional[str] = None, _blocking_ready: bool = True) -> DeploymentHandle:

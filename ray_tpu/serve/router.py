@@ -43,6 +43,7 @@ import ray_tpu
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.deadline import Deadline, effective_timeout
 from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
+from ray_tpu.observability import tracing as _tracing
 
 _STATS_TTL_S = 0.25
 
@@ -323,19 +324,28 @@ class Router:
     # -- dispatch ---------------------------------------------------------
     def dispatch(self, method: str, args, kwargs, model_id: str = ""):
         """At-most-once: returns the replica call's ObjectRef."""
-        replica = self.choose_replica(model_id, args)
-        self._bump(replica)
-        return replica.handle_request.remote(
-            method, list(args), dict(kwargs or {}), model_id
-        )
+        # a serve request is a trace ENTRY POINT: sample a root here (or
+        # inherit the caller's ambient trace) so the replica push — and
+        # everything the replica does — parents to this dispatch span
+        with _tracing.root_span(
+            f"serve::{self._deployment}.{method}", "serve"
+        ):
+            replica = self.choose_replica(model_id, args)
+            self._bump(replica)
+            return replica.handle_request.remote(
+                method, list(args), dict(kwargs or {}), model_id
+            )
 
     def dispatch_stream(self, method: str, args, kwargs, model_id: str = ""):
         """Streaming call: returns the replica generator's ref iterator."""
-        replica = self.choose_replica(model_id, args)
-        self._bump(replica)
-        return replica.handle_request_streaming.options(
-            num_returns="streaming"
-        ).remote(method, list(args), dict(kwargs or {}), model_id)
+        with _tracing.root_span(
+            f"serve::{self._deployment}.{method}", "serve"
+        ):
+            replica = self.choose_replica(model_id, args)
+            self._bump(replica)
+            return replica.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(method, list(args), dict(kwargs or {}), model_id)
 
     def execute(
         self,
@@ -377,30 +387,34 @@ class Router:
         budget = effective_timeout(timeout)
         deadline = Deadline.after(budget if budget is not None else 3600)
         last_err: Optional[Exception] = None
-        while not deadline.expired:
-            replica = self.choose_replica(model_id, args)
-            self._bump(replica)
-            try:
-                ref = replica.handle_request.remote(
-                    method, list(args), dict(kwargs or {}), model_id
-                )
-            except (ActorDiedError, WorkerCrashedError) as e:
-                # submission failed: the request never reached a replica,
-                # safe to re-choose even for non-idempotent work
-                last_err = e
-                self._drop_replica(replica)
-                continue
-            try:
-                remaining = max(1.0, deadline.remaining())
-                return ray_tpu.get(ref, timeout=remaining)
-            except (ActorDiedError, WorkerCrashedError) as e:
-                last_err = e
-                self._drop_replica(replica)
-                if not idempotent:
-                    # the push may have been delivered and executed —
-                    # replaying could duplicate a side effect
-                    raise
-                continue
+        # trace root covering dispatch retries AND the result get: the
+        # replica-side spans parent to this one
+        with _tracing.root_span(f"serve::{self._deployment}.{method}", "serve"):
+            while not deadline.expired:
+                replica = self.choose_replica(model_id, args)
+                self._bump(replica)
+                try:
+                    ref = replica.handle_request.remote(
+                        method, list(args), dict(kwargs or {}), model_id
+                    )
+                except (ActorDiedError, WorkerCrashedError) as e:
+                    # submission failed: the request never reached a
+                    # replica, safe to re-choose even for non-idempotent
+                    # work
+                    last_err = e
+                    self._drop_replica(replica)
+                    continue
+                try:
+                    remaining = max(1.0, deadline.remaining())
+                    return ray_tpu.get(ref, timeout=remaining)
+                except (ActorDiedError, WorkerCrashedError) as e:
+                    last_err = e
+                    self._drop_replica(replica)
+                    if not idempotent:
+                        # the push may have been delivered and executed —
+                        # replaying could duplicate a side effect
+                        raise
+                    continue
         raise last_err or TimeoutError(
             f"no replica executed {self._deployment}.{method} in time"
         )
@@ -428,36 +442,41 @@ class Router:
         # tighter ambient deadline already folded in; None = wait forever
         item_timeout = budget
         last_err: Optional[Exception] = None
-        while not deadline.expired:
-            replica = self.choose_replica(model_id, args)
-            self._bump(replica)
-            gen = replica.handle_request_streaming.options(
-                num_returns="streaming"
-            ).remote(method, list(args), dict(kwargs or {}), model_id)
-            try:
-                # bounded time-to-first-item: a replica stuck before its
-                # first yield must not park this request forever
-                first_ref = gen.next_with_timeout(
-                    max(1.0, deadline.remaining())
-                )
-                first = ray_tpu.get(first_ref, timeout=max(1.0, deadline.remaining()))
-            except StopIteration:
-                def _empty():
-                    return
-                    yield  # pragma: no cover
-                return _empty()
-            except (ActorDiedError, WorkerCrashedError) as e:
-                last_err = e
-                self._drop_replica(replica)
-                continue
-            it = iter(gen)
+        # trace root spanning dispatch → first item (the serve TTFT
+        # window); the replica's streaming task span parents to it
+        with _tracing.root_span(f"serve::{self._deployment}.{method}", "serve"):
+            while not deadline.expired:
+                replica = self.choose_replica(model_id, args)
+                self._bump(replica)
+                gen = replica.handle_request_streaming.options(
+                    num_returns="streaming"
+                ).remote(method, list(args), dict(kwargs or {}), model_id)
+                try:
+                    # bounded time-to-first-item: a replica stuck before
+                    # its first yield must not park this request forever
+                    first_ref = gen.next_with_timeout(
+                        max(1.0, deadline.remaining())
+                    )
+                    first = ray_tpu.get(
+                        first_ref, timeout=max(1.0, deadline.remaining())
+                    )
+                except StopIteration:
+                    def _empty():
+                        return
+                        yield  # pragma: no cover
+                    return _empty()
+                except (ActorDiedError, WorkerCrashedError) as e:
+                    last_err = e
+                    self._drop_replica(replica)
+                    continue
+                it = iter(gen)
 
-            def _rest(first=first, it=it):
-                yield first
-                for ref in it:
-                    yield ray_tpu.get(ref, timeout=item_timeout)
+                def _rest(first=first, it=it):
+                    yield first
+                    for ref in it:
+                        yield ray_tpu.get(ref, timeout=item_timeout)
 
-            return _rest()
+                return _rest()
         raise last_err or TimeoutError(
             f"no replica started stream {self._deployment}.{method} in time"
         )

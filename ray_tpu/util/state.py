@@ -45,3 +45,16 @@ def summarize_tasks() -> Dict[str, int]:
     for t in list_tasks():
         out[t["state"]] = out.get(t["state"], 0) + 1
     return out
+
+
+def cluster_status() -> Dict[str, Any]:
+    """One-call live cluster view (``ray_tpu.cluster_status()``)."""
+    return _call("cluster_status")
+
+
+def cluster_telemetry() -> Dict[str, Any]:
+    """Federated metrics: ``{"controller": text, "nodes": {node_hex:
+    text}, "federate_port"}`` — raw Prometheus exposition per source;
+    the merged node-labeled view is served at the controller's
+    ``/federate`` HTTP path."""
+    return _call("cluster_telemetry")
