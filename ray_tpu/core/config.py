@@ -208,6 +208,12 @@ class GlobalConfig:
     #: backlog (it removes one prefill token of work); raise it to pin
     #: conversations harder, 0 disables affinity (pure least-tokens).
     serve_affinity_weight: float = 1.0
+    #: how often the serve controller polls replica.health() (the user
+    #: callable's check_health — e.g. the LLM engine's wedged-step-loop
+    #: detector) and restarts replicas that ANSWER but report unhealthy.
+    #: Liveness reaping alone never catches a stalled engine whose actor
+    #: loop still replies. <= 0 disables the poll.
+    serve_replica_health_period_s: float = 1.0
 
     # --- runtime_env ---
     #: TTL on the driver-side working_dir/py_modules change-signature
@@ -266,6 +272,15 @@ class GlobalConfig:
     #: RNG seed for the pull fault plan; 0 = generate one (logged at
     #: activation for replay)
     testing_pull_chaos_seed: int = 0
+    #: seeded REPLICA fault plan consulted by the LLM engine's step loop
+    #: once per executed step phase: "mode:prob[:param][:max],..." with
+    #: mode in {kill_mid_decode, kill_mid_prefill, stall} — see
+    #: util/chaos.py::ReplicaFaultPlan (same determinism contract as
+    #: RpcFaultPlan). Empty = no injection.
+    testing_replica_chaos: str = ""
+    #: RNG seed for the replica fault plan; 0 = generate one (logged at
+    #: activation for replay)
+    testing_replica_chaos_seed: int = 0
 
     def reset(self) -> None:
         for f in fields(self):

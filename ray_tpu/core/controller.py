@@ -833,7 +833,13 @@ class Controller:
         return {"ok": True}
 
     async def c_report_actor_death(self, payload, conn):
-        await self._handle_actor_death(payload["actor_id"], payload.get("reason", "worker died"))
+        # ``drained``: the reporting daemon was mid-drain — the death is
+        # a preemption casualty, budget-free like the deregister failover
+        await self._handle_actor_death(
+            payload["actor_id"],
+            payload.get("reason", "worker died"),
+            drained=bool(payload.get("drained", False)),
+        )
         return {"ok": True}
 
     async def _handle_actor_death(

@@ -263,6 +263,28 @@ class NodeDaemon:
                 await self._flush_objects(deadline)
             except Exception:
                 logger.warning("drain object flush failed", exc_info=True)
+        # 3b. the grace is spent: any worker still hosting an actor or a
+        #    running lease is in the documented abrupt-death fallback —
+        #    reap it BEFORE deregistering. Deregistration makes the
+        #    controller restart our actors (and resubmit our tasks)
+        #    elsewhere immediately; a stale worker that outlives it can
+        #    still answer pushes from clients with cached addresses, so
+        #    one actor briefly has TWO live incarnations — the old one
+        #    answering a call the new one should get (the test_drain
+        #    pid2==pid1 flake: the budget-free restart happened, but the
+        #    not-yet-reaped old worker answered first), and a task
+        #    re-executed elsewhere can double its side effects.
+        stale = [
+            w.proc
+            for w in self.workers.values()
+            if w.actor_id is not None or w.leased
+        ]
+        if stale and not self._stopping:
+            from ray_tpu.util.reaper import reap_all
+
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: reap_all(stale)
+            )
         # 4. deregister: the controller fails our remaining actors over
         #    budget-free NOW instead of waiting out the health checker
         try:
@@ -771,6 +793,12 @@ class NodeDaemon:
                             {
                                 "actor_id": w.actor_id,
                                 "reason": f"worker exited with code {code}",
+                                # deaths during OUR drain are preemption
+                                # casualties (incl. the pre-deregister
+                                # reap of grace overstayers): restarts
+                                # must stay budget-free, same as the
+                                # deregistration-path failover
+                                "drained": self._draining,
                             },
                         )
                     except Exception:

@@ -42,7 +42,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 import threading
 import time
 import zlib
@@ -71,38 +70,24 @@ _Source = Tuple[str, int]
 # ---------------------------------------------------------------------------
 # seeded data-plane fault plan (same lazy-activation contract as
 # rpc.active_fault_plan: built once per (spec, seed), seed logged so a
-# failure reproduces from the log alone)
+# failure reproduces from the log alone — util/chaos.py::SeededPlanCache)
 
-_PLAN_LOCK = threading.Lock()
-_PLAN_KEY: Optional[Tuple[str, int]] = None
-_PLAN = None
+_PLAN_CACHE = None
+_PLAN_CACHE_LOCK = threading.Lock()
 
 
 def active_pull_fault_plan():
-    spec = GLOBAL_CONFIG.testing_pull_chaos
-    if not spec:
-        return None
-    global _PLAN_KEY, _PLAN
-    key = (spec, GLOBAL_CONFIG.testing_pull_chaos_seed)
-    if _PLAN_KEY == key:
-        return _PLAN
-    with _PLAN_LOCK:
-        if _PLAN_KEY == key:
-            return _PLAN
-        from ray_tpu.util.chaos import DataFaultPlan
+    global _PLAN_CACHE
+    if _PLAN_CACHE is None:
+        from ray_tpu.util.chaos import DataFaultPlan, SeededPlanCache
 
-        seed = GLOBAL_CONFIG.testing_pull_chaos_seed or (
-            int.from_bytes(os.urandom(4), "little") | 1
-        )
-        plan = DataFaultPlan(spec, seed)
-        logger.warning(
-            "pull chaos plan ACTIVE: spec=%r seed=%d "
-            "(reproduce: RAY_TPU_testing_pull_chaos=%r "
-            "RAY_TPU_testing_pull_chaos_seed=%d)",
-            spec, seed, spec, seed,
-        )
-        _PLAN, _PLAN_KEY = plan, key
-        return plan
+        with _PLAN_CACHE_LOCK:
+            if _PLAN_CACHE is None:
+                _PLAN_CACHE = SeededPlanCache(
+                    DataFaultPlan, "pull",
+                    "testing_pull_chaos", "testing_pull_chaos_seed", logger,
+                )
+    return _PLAN_CACHE.active()
 
 
 def _count_injection(mode: str) -> None:

@@ -188,40 +188,27 @@ def _chaos_should_fail(method: str) -> bool:
     return (name == "*" or name == method) and random.random() < float(prob)
 
 
-_PLAN_LOCK = threading.Lock()
-_PLAN_KEY: Optional[Tuple[str, int]] = None
-_PLAN = None
+_PLAN_CACHE = None
+_PLAN_CACHE_LOCK = threading.Lock()
 
 
 def active_fault_plan():
     """The process-wide seeded fault plan for ``testing_rpc_chaos`` (or
     None). Built lazily and rebuilt when the spec/seed config changes;
     the seed is logged at activation so a failure reproduces from the
-    log alone (set ``RAY_TPU_testing_rpc_chaos_seed`` to replay)."""
-    spec = GLOBAL_CONFIG.testing_rpc_chaos
-    if not spec:
-        return None
-    global _PLAN_KEY, _PLAN
-    key = (spec, GLOBAL_CONFIG.testing_rpc_chaos_seed)
-    if _PLAN_KEY == key:
-        return _PLAN
-    with _PLAN_LOCK:
-        if _PLAN_KEY == key:
-            return _PLAN
-        from ray_tpu.util.chaos import RpcFaultPlan
+    log alone (set ``RAY_TPU_testing_rpc_chaos_seed`` to replay) —
+    util/chaos.py::SeededPlanCache."""
+    global _PLAN_CACHE
+    if _PLAN_CACHE is None:
+        from ray_tpu.util.chaos import RpcFaultPlan, SeededPlanCache
 
-        seed = GLOBAL_CONFIG.testing_rpc_chaos_seed or (
-            int.from_bytes(os.urandom(4), "little") | 1
-        )
-        plan = RpcFaultPlan(spec, seed)
-        logger.warning(
-            "rpc chaos plan ACTIVE: spec=%r seed=%d "
-            "(reproduce: RAY_TPU_testing_rpc_chaos=%r "
-            "RAY_TPU_testing_rpc_chaos_seed=%d)",
-            spec, seed, spec, seed,
-        )
-        _PLAN, _PLAN_KEY = plan, key
-        return plan
+        with _PLAN_CACHE_LOCK:
+            if _PLAN_CACHE is None:
+                _PLAN_CACHE = SeededPlanCache(
+                    RpcFaultPlan, "rpc",
+                    "testing_rpc_chaos", "testing_rpc_chaos_seed", logger,
+                )
+    return _PLAN_CACHE.active()
 
 
 def _next_fault(method: str) -> Optional[Tuple[str, float]]:
@@ -654,6 +641,10 @@ class RpcClient:
         self._pending: Dict[int, asyncio.Future] = {}
         self._push_handlers: Dict[int, Callable[[Any], None]] = {}
         self._conn_lock: Optional[asyncio.Lock] = None
+        #: monotonic stamp of the last FAILED connect attempt: callers
+        #: already parked on the lock while it ran fail together instead
+        #: of serially re-running the full connect-timeout loop each
+        self._last_connect_failure = float("-inf")
         self._read_task: Optional[asyncio.Task] = None
         self._closed = False
         # write cork (see ServerConnection): frames issued in one loop
@@ -673,9 +664,20 @@ class RpcClient:
         if self._conn_lock is None:
             self._conn_lock = asyncio.Lock()
         reconnected = False
+        entered = time.monotonic()
         async with self._conn_lock:
             if self._writer is not None and not self._writer.is_closing():
                 return
+            if self._last_connect_failure >= entered:
+                # a connect attempt that spanned our ENTIRE wait just
+                # exhausted its timeout against this address — fail
+                # together. Without this, N concurrent calls to a dead
+                # peer serialize behind the lock and pay N x the connect
+                # timeout (a dead object-transfer source made ten
+                # concurrent pulls crawl through ~10s probes one by
+                # one). A call arriving AFTER the failure still gets a
+                # full fresh attempt — the peer may be back.
+                raise ConnectionLost(f"cannot connect to {self.name}")
             from ray_tpu.core.deadline import effective_timeout
 
             budget = effective_timeout(
@@ -689,6 +691,7 @@ class RpcClient:
                     break
                 except OSError:
                     if time.monotonic() > deadline or self._closed:
+                        self._last_connect_failure = time.monotonic()
                         raise ConnectionLost(f"cannot connect to {self.name}")
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, GLOBAL_CONFIG.rpc_retry_max_delay_s)

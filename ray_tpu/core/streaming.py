@@ -7,9 +7,15 @@ runs. The executing worker pushes each item back over the submission
 connection (ordered by TCP); the owner records them in an
 ``ObjectRefStream`` and hands them out through an ``ObjectRefGenerator``.
 
-Retries are disabled for streaming tasks in this build (re-executing a
+Retries are disabled for streaming tasks at THIS layer (re-executing a
 partially-consumed stream has replay semantics the reference spent a
-protocol on; a died worker surfaces as the stream erroring).
+protocol on; a died worker surfaces as the stream erroring). The serve
+router implements replay ABOVE this layer for deployments that declare
+``resumable_streams``: items carry a per-request monotonic sequence
+number, an interrupted stream is re-dispatched to a survivor with
+``resume_from`` set, and :class:`SeqGate` suppresses replayed duplicates
+so the client-visible sequence has no gaps and no repeats
+(``serve/router.py``).
 
 Producer-side backpressure (the reference's consumer-position protocol):
 the generator pauses once ``produced - consumed`` reaches
@@ -41,6 +47,38 @@ def streaming_error_result(err) -> tuple:
     import pickle
 
     return (b"", "error", pickle.dumps(err))
+
+
+class SeqGate:
+    """Consumer-side duplicate/gap gate for seq-numbered resumable
+    streams (serve router exactly-once token delivery).
+
+    Every item of a resumable stream is a ``(seq, value)`` pair with a
+    per-request monotonic seq. The gate admits exactly the item whose
+    seq it expects next; anything below is a replayed duplicate (a
+    failed-over producer re-emitting the boundary item the consumer
+    already delivered) and is suppressed; anything above is a protocol
+    violation — a resumed producer must start exactly at ``next_seq``,
+    so a gap can only mean lost delivery, which must fail loudly rather
+    than silently skip items."""
+
+    __slots__ = ("next_seq",)
+
+    def __init__(self, start: int = 0):
+        self.next_seq = int(start)
+
+    def admit(self, seq: int) -> bool:
+        """True → deliver (and advance); False → suppress a duplicate.
+        Raises RuntimeError on a gap."""
+        seq = int(seq)
+        if seq == self.next_seq:
+            self.next_seq += 1
+            return True
+        if seq < self.next_seq:
+            return False
+        raise RuntimeError(
+            f"resumable stream gap: expected seq {self.next_seq}, got {seq}"
+        )
 
 
 class ObjectRefStream:

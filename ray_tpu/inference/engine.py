@@ -20,12 +20,27 @@ Request lifecycle hooks the rest of the runtime:
   queue-depth gauges through ``observability.metrics`` and a per-step
   ``timeline`` profile event (chrome://tracing shows prefill/decode
   interleave per step).
+* **deterministic continuation** — sampling is keyed on
+  ``(request seed, absolute position)`` (:meth:`_sample`), so a request
+  resubmitted with ``prompt + generated[:k]`` continues the identical
+  token stream on ANY engine with the same params. That property is
+  what the serve router's resumable-stream protocol (exactly-once token
+  delivery across replica death) is built on.
+* **chaos + health** — ``testing_replica_chaos`` installs a seeded
+  :class:`util.chaos.ReplicaFaultPlan` consulted at the step boundary
+  (kill mid-prefill/mid-decode, stall); :meth:`healthy` exposes a
+  wedged-step-loop detector the serve controller polls through
+  ``replica.health()``.
 """
 
 from __future__ import annotations
 
+import hashlib
+import logging
 import math
+import os
 import queue
+import signal
 import threading
 import time
 import uuid
@@ -50,6 +65,40 @@ from ray_tpu.observability import timeline
 from ray_tpu.observability import tracing as _tracing
 
 _END = object()  # stream sentinel
+
+logger = logging.getLogger(__name__)
+
+# -- replica chaos (util/chaos.py::ReplicaFaultPlan) -------------------------
+_RPLAN_CACHE = None
+_RPLAN_CACHE_LOCK = threading.Lock()
+
+
+def active_replica_fault_plan():
+    """The process-wide seeded replica fault plan for
+    ``testing_replica_chaos`` (or None); seed logged at activation
+    (util/chaos.py::SeededPlanCache)."""
+    global _RPLAN_CACHE
+    if _RPLAN_CACHE is None:
+        from ray_tpu.util.chaos import ReplicaFaultPlan, SeededPlanCache
+
+        with _RPLAN_CACHE_LOCK:
+            if _RPLAN_CACHE is None:
+                _RPLAN_CACHE = SeededPlanCache(
+                    ReplicaFaultPlan, "replica",
+                    "testing_replica_chaos", "testing_replica_chaos_seed",
+                    logger,
+                )
+    return _RPLAN_CACHE.active()
+
+
+def _stable_request_seed(request_id: str) -> int:
+    """Process-independent sampling seed derived from a request id.
+    ``hash()`` is salted per interpreter (PYTHONHASHSEED), which would
+    make a request resumed on another replica sample a DIFFERENT stream
+    — breaking exactly-once token delivery for unseeded requests."""
+    return int.from_bytes(
+        hashlib.blake2b(request_id.encode(), digest_size=8).digest(), "little"
+    )
 
 
 class EngineDrainingError(RuntimeError):
@@ -90,6 +139,13 @@ class EngineConfig:
     #: after a tokens() timeout without cancel()) would otherwise pin its
     #: queue in the replica forever. <= 0 disables.
     finished_stream_ttl_s: float = 300.0
+    #: healthy() reports False once there is pending work but the step
+    #: loop hasn't completed an iteration for this long — a wedged step
+    #: thread (stuck device call, injected stall) in a replica whose
+    #: actor loop still answers RPCs. The serve controller polls this
+    #: through replica.health() and restarts the replica. <= 0 disables
+    #: the staleness check (thread liveness is still checked).
+    step_stall_unhealthy_s: float = 10.0
     #: prefix caching (kv_cache.py): full blocks are indexed by token
     #: chain-hash and SHARED with later requests whose prompt prefix
     #: matches — those skip the covered prefill chunks entirely (the
@@ -214,7 +270,6 @@ class InferenceEngine:
             max_queue_depth=ec.max_queue_depth,
         )
         self._out: Dict[str, queue.Queue] = {}
-        self._rngs: Dict[str, np.random.RandomState] = {}
         # request id -> submitter's (trace_id, span_id): the step-loop
         # thread stamps per-request spans (admission→first-token,
         # admission→finish) under the serve caller's trace
@@ -232,6 +287,13 @@ class InferenceEngine:
         self._drain_deadline: Optional[Deadline] = None
         self._listener_backend = None
         self._node_listener = None
+        #: step-loop heartbeat consumed by healthy(): stamped once per
+        #: loop iteration, so a step wedged inside device code (or an
+        #: injected stall) goes stale while the actor loop stays live
+        self._last_beat = time.monotonic()
+        #: per-engine fault-plan override (tests arm ONE replica
+        #: surgically); None falls through to the env/config plan
+        self.testing_fault_plan = None
         self.metrics = _engine_metrics()
         self._ttfts: deque = deque(maxlen=512)
         self._token_times: deque = deque(maxlen=2048)
@@ -266,6 +328,7 @@ class InferenceEngine:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            self._last_beat = time.monotonic()
             did_work = False
             try:
                 did_work = self.step()
@@ -311,6 +374,11 @@ class InferenceEngine:
             )
         max_new = min(max_new, room)
         rid = request_id or uuid.uuid4().hex[:16]
+        if temperature > 0.0 and seed is None:
+            # resolve ONCE, stably: sampling is keyed on (seed, position)
+            # so a resumed/replayed request re-derives the identical
+            # stream from its id alone (see _sample / _stable_request_seed)
+            seed = _stable_request_seed(rid)
         budget = deadline_remaining()
         if timeout_s is not None:
             budget = timeout_s if budget is None else min(budget, timeout_s)
@@ -329,10 +397,6 @@ class InferenceEngine:
             if rid in self._out:
                 raise ValueError(f"duplicate request_id {rid!r}")
             self._out[rid] = queue.Queue()
-            if temperature > 0.0:
-                self._rngs[rid] = np.random.RandomState(
-                    seed if seed is not None else (hash(rid) & 0x7FFFFFFF)
-                )
             if trace_wire is not None:
                 self._trace_ctx[rid] = trace_wire
             self._submitted_at[rid] = time.monotonic()
@@ -341,7 +405,6 @@ class InferenceEngine:
         except Exception:
             with self._lock:
                 self._out.pop(rid, None)
-                self._rngs.pop(rid, None)
                 self._trace_ctx.pop(rid, None)
                 self._submitted_at.pop(rid, None)
             raise
@@ -500,6 +563,7 @@ class InferenceEngine:
             )
         if not plan.prefills and not plan.decodes:
             return not plan.empty
+        self._consult_replica_chaos(plan)
 
         # timeline timestamps share the module's wall-clock epoch so
         # engine_step events merge with every other process's trace
@@ -554,14 +618,59 @@ class InferenceEngine:
 
     # -- internals --------------------------------------------------------
     def _sample(self, req: Request, logits: np.ndarray) -> int:
+        """Deterministic continuation: the RNG is keyed on
+        ``(request seed, absolute position)`` instead of a stateful
+        per-request stream. ``len(prompt) + len(generated)`` equals the
+        original sequence position regardless of how much of the
+        sequence arrived AS prompt — so a request resubmitted as
+        ``prompt + generated[:k]`` provably samples token k+1
+        identically, which is what makes mid-stream failover replay
+        byte-exact (serve router resume; pinned by
+        tests/test_stream_resume.py)."""
         if req.temperature <= 0.0:
             return int(np.argmax(logits))
-        rng = self._rngs.get(req.request_id) or np.random.RandomState(0)
+        pos = len(req.prompt) + len(req.generated)
+        seed = req.seed if req.seed is not None else 0
+        rng = np.random.default_rng(
+            np.random.SeedSequence([int(seed) & 0xFFFFFFFFFFFFFFFF, pos])
+        )
         z = (logits / req.temperature).astype(np.float64)
         z -= z.max()
         p = np.exp(z)
         p /= p.sum()
         return int(rng.choice(len(p), p=p))
+
+    def _consult_replica_chaos(self, plan) -> None:
+        """Replica fault injection at the step boundary (ReplicaFaultPlan):
+        consulted once per phase this step actually runs, BEFORE the
+        phase's device work — a kill lands after the last emitted token
+        and before the next one samples, the boundary the router's
+        seq-numbered resume must cover."""
+        chaos = self.testing_fault_plan or active_replica_fault_plan()
+        if chaos is None:
+            return
+        for phase, present in (
+            ("prefill", bool(plan.prefills)),
+            ("decode", bool(plan.decodes)),
+        ):
+            if not present:
+                continue
+            fault = chaos.consult(phase)
+            if fault is None:
+                continue
+            mode, param = fault
+            if mode == "stall":
+                logger.warning(
+                    "replica chaos: stalling step loop %.2fs (seed=%d)",
+                    param, chaos.seed,
+                )
+                time.sleep(param)
+            else:
+                logger.warning(
+                    "replica chaos: %s — SIGKILL self (pid=%d seed=%d)",
+                    mode, os.getpid(), chaos.seed,
+                )
+                os.kill(os.getpid(), signal.SIGKILL)
 
     def _emit_token(self, req: Request, token: int) -> None:
         if req.finished:
@@ -622,7 +731,6 @@ class InferenceEngine:
             q = self._out.get(req.request_id)
             submitted = self._submitted_at.pop(req.request_id, None)
             wire = self._trace_ctx.pop(req.request_id, None)
-            self._rngs.pop(req.request_id, None)
             self._first_token_at.pop(req.request_id, None)
             if q is not None:
                 # the queue stays for a late tokens() call; stamp it so an
@@ -749,6 +857,21 @@ class InferenceEngine:
             "prefix_digest": self.blocks.prefix_digest(),
             "draining": self._draining,
         }
+
+    def healthy(self) -> bool:
+        """Liveness the serve controller polls through ``replica.health()``:
+        False once the step loop is dead, or wedged — work pending with
+        no loop heartbeat inside ``step_stall_unhealthy_s``. A stalled
+        step thread doesn't stop the actor's async loop from answering
+        RPCs, so plain reachability checks can never catch it."""
+        if self._stop.is_set():
+            return False
+        if self._thread is None or not self._thread.is_alive():
+            return False
+        stall = self.engine_cfg.step_stall_unhealthy_s
+        if stall > 0 and self.scheduler.has_work():
+            return time.monotonic() - self._last_beat <= stall
+        return True
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
         """Block until no queued/running work remains (drain helper)."""

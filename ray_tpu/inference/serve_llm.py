@@ -22,12 +22,17 @@ each replica engine subscribes to the node DRAINING push — a preemption
 warning stops admission while Serve unroutes the replica and waits for
 the in-flight streams, so clients see completed generations, not errors.
 
-Retry semantics note: ``handle.call``/``router.execute`` are
-at-least-once — a replica death mid-call re-executes the generation on a
-survivor. Generation is NOT idempotent across replicas (fresh params =
-same tokens, but duplicated sampling work); callers that care should use
-``handle.stream`` (retries only before the first token) or pass a
-``request_id`` and dedupe downstream.
+Retry semantics note (the three-tier contract, serve/router.py):
+``handle.call``/``router.execute`` are at-least-once across replica
+death; ``handle.stream(..., _method="generate")`` is EXACTLY-ONCE —
+``generate`` is declared in :attr:`LLMServer.resumable_streams`, so the
+router resumes an interrupted stream on a survivor with the prompt
+extended by the already-delivered tokens, and deterministic continuation
+(engine sampling keyed on ``(seed, position)``) makes the replayed
+stream byte-exact. The replay is sound ONLY because generation is
+side-effect-free and deterministic given (params seed, request seed,
+prompt) — a callable with external side effects must not declare its
+streams resumable.
 """
 
 from __future__ import annotations
@@ -41,6 +46,14 @@ class LLMServer:
     Defined undecorated at module level so cloudpickle exports it by
     reference (see serve/replica.py for the rationale).
     """
+
+    #: streaming methods that are safe to RESUME on another replica after
+    #: a mid-stream death (serve router exactly-once token delivery).
+    #: Sound here because generation is deterministic (same params seed +
+    #: request seed + prompt → same tokens, engine sampling keyed on
+    #: (seed, position)) and side-effect-free; anything that writes to
+    #: the outside world per item must never appear in this tuple.
+    resumable_streams = ("generate",)
 
     def __init__(
         self,
@@ -94,17 +107,68 @@ class LLMServer:
         """Streaming entry (call with ``num_returns="streaming"`` /
         ``handle.stream(..., _method="generate")``): yields token ids as
         they decode. Request fields: prompt (required), max_new_tokens,
-        temperature, priority, eos_token, request_id, seed."""
+        temperature, priority, eos_token, request_id, seed, resume_from.
+
+        ``resume_from`` (stamped by the serve router for resumable
+        streams; absent for direct callers) switches to seq-numbered
+        mode: the prompt carries ``resume_from`` already-delivered
+        tokens of an interrupted stream, and items become
+        ``(seq, token)`` pairs so the router can suppress replayed
+        duplicates at the failover boundary. ``max_new_tokens`` stays
+        the ORIGINAL request's cap — the replica subtracts what was
+        already delivered, so the client-visible stream length never
+        changes across failovers."""
         r = self._parse(request)
-        yield from self.engine.generate(
+        resume_from = r.get("resume_from")
+        if resume_from is None:
+            yield from self.engine.generate(
+                r["prompt"],
+                max_new_tokens=r.get("max_new_tokens"),
+                temperature=float(r.get("temperature", 0.0)),
+                priority=int(r.get("priority", 0)),
+                eos_token=r.get("eos_token"),
+                request_id=r.get("request_id"),
+                seed=r.get("seed"),
+            )
+            return
+        seq = int(resume_from)
+        max_new = r.get("max_new_tokens")
+        if max_new is None:
+            max_new = self.engine.engine_cfg.max_new_tokens_default
+        # the cap the ORIGINAL run actually obeyed: the engine clamps
+        # max_new_tokens to the context room (max_seq_len - prompt), so
+        # a room-clamped stream ends early — resume math must use the
+        # clamped cap, or a death exactly after the last clamped token
+        # would resubmit with remaining>0 and a full-context prompt,
+        # raising "prompt >= max_seq_len" instead of closing cleanly
+        orig_prompt_len = len(r["prompt"]) - seq
+        effective_cap = min(
+            int(max_new), max(0, self.engine.cfg.max_seq_len - orig_prompt_len)
+        )
+        remaining = effective_cap - seq
+        if remaining <= 0:
+            # the whole (clamped) budget was delivered before the
+            # failover: the resume covers only the end-of-stream signal
+            return
+        eos = r.get("eos_token")
+        if eos is not None and seq > 0 and r["prompt"][-1] == eos:
+            # the stream already ENDED at this EOS — it was delivered,
+            # then the replica died before the end-of-stream signal. The
+            # engine's EOS check applies only to SAMPLED tokens, so
+            # decoding past the replayed EOS would emit tokens an
+            # undisturbed run never produced.
+            return
+        for tok in self.engine.generate(
             r["prompt"],
-            max_new_tokens=r.get("max_new_tokens"),
+            max_new_tokens=remaining,
             temperature=float(r.get("temperature", 0.0)),
             priority=int(r.get("priority", 0)),
             eos_token=r.get("eos_token"),
             request_id=r.get("request_id"),
             seed=r.get("seed"),
-        )
+        ):
+            yield (seq, tok)
+            seq += 1
 
     def __call__(self, request) -> Dict[str, Any]:
         """Non-streaming: returns the full generation in one reply."""
@@ -131,7 +195,19 @@ class LLMServer:
         self.engine.begin_drain(grace_s)
 
     def check_health(self) -> bool:
-        return not self.engine._stop.is_set()
+        """Polled by the serve controller (replica.health): False once
+        the engine's step loop is dead or wedged — the signal that gets
+        a stalled replica proactively restarted (engine.healthy())."""
+        return self.engine.healthy()
+
+    def testing_arm_replica_chaos(self, spec: str, seed: int) -> int:
+        """Test hook: install a ReplicaFaultPlan on THIS replica only
+        (the env/config plan arms every replica including controller
+        replacements — surgical tests target one). Returns the seed."""
+        from ray_tpu.util.chaos import ReplicaFaultPlan
+
+        self.engine.testing_fault_plan = ReplicaFaultPlan(spec, seed)
+        return seed
 
     def __del__(self):
         try:

@@ -148,3 +148,31 @@ ROUTER_AFFINITY_HITS = Counter(
     "scored routing decisions that landed on a prefix-warm replica",
     ("deployment",),
 )
+
+# -- resumable streams (serve/router.py exactly-once token delivery) --------
+# Every resume is a mid-stream replica death the client never saw: the
+# router re-dispatched to a survivor with the delivered prefix replayed
+# and the SeqGate suppressed the boundary duplicates.
+
+#: mid-stream failovers of resumable streams (one inc per re-dispatch)
+STREAM_RESUMES = Counter(
+    "raytpu_stream_resumes_total",
+    "resumable serve streams re-dispatched after mid-stream replica death",
+    ("deployment",),
+)
+
+#: already-delivered tokens replayed as prompt extension on resume —
+#: the work the survivor's prefix cache absorbs (vs a cold re-prefill)
+STREAM_RESUME_REPLAY_TOKENS = Counter(
+    "raytpu_stream_resume_replay_tokens_total",
+    "delivered tokens replayed as prompt extension by stream resumes",
+)
+
+#: ready serve replicas killed for replacement, by reason — death =
+#: observed dead (SIGKILL, crash); unhealthy = the replica ANSWERED but
+#: its check_health reported a wedged engine (proactive restart)
+SERVE_REPLICA_RESTARTS = Counter(
+    "raytpu_serve_replica_restarts_total",
+    "serve replicas killed for replacement, by reason (death|unhealthy)",
+    ("reason",),
+)

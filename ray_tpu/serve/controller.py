@@ -26,6 +26,19 @@ from ray_tpu.serve.replica import Replica
 CONTROLLER_NAME = "__serve_controller__"
 
 
+def _count_replica_restart(state: "_DeploymentState", reason: str) -> None:
+    """A ready replica was killed for replacement: observed death or an
+    unhealthy self-report. Counted on the controller's /metrics registry
+    AND on the deployment state (surfaced via status())."""
+    state.restarts[reason] = state.restarts.get(reason, 0) + 1
+    try:
+        from ray_tpu.observability.rpc_metrics import SERVE_REPLICA_RESTARTS
+
+        SERVE_REPLICA_RESTARTS.inc(labels={"reason": reason})
+    except Exception:
+        pass
+
+
 class _DeploymentState:
     def __init__(self, name, cls_or_fn, init_args, init_kwargs, config: DeploymentConfig):
         self.name = name
@@ -58,6 +71,13 @@ class _DeploymentState:
         #: load + prefix-digest gossip from gossip-capable replicas
         #: (serve/replica.py), shipped to routers with the routing set
         self.replica_stats: Dict[str, Tuple[Dict[str, Any], float]] = {}
+        #: last replica.health() poll sweep (proactive wedged-replica
+        #: restart rides its own cadence, not every reconcile pass)
+        self.last_health_ts = 0.0
+        #: ready replicas killed for replacement, by reason — mirrored
+        #: into status() so tests/operators see it without scraping the
+        #: controller process's /metrics
+        self.restarts: Dict[str, int] = {"death": 0, "unhealthy": 0}
 
 
 class _ServeController:
@@ -131,6 +151,7 @@ class _ServeController:
                 state.replicas = old.replicas
                 state.starting = old.starting
                 state.draining = old.draining
+                state.restarts = old.restarts
             self._deployments[name] = state
         self._reconcile_once()
         return True
@@ -311,6 +332,21 @@ class _ServeController:
                 # re-samples target/autoscale changes that don't bump
                 self._change.wait(min(remaining, 0.25))
 
+    def resumable_stream_methods(self, name: str) -> List[str]:
+        """Streaming methods the deployment's CALLABLE declares
+        replay-safe (``resumable_streams`` class attribute) — read off
+        the deployed class object, no replica round-trip. Routers fetch
+        this once and upgrade ``execute_stream`` to exactly-once token
+        delivery for these methods (serve/router.py tier 3)."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return []
+            return [
+                str(m)
+                for m in (getattr(st.cls_or_fn, "resumable_streams", ()) or ())
+            ]
+
     def routes(self) -> Dict[str, str]:
         """route_prefix -> deployment name (proxy routing table)."""
         with self._lock:
@@ -335,6 +371,7 @@ class _ServeController:
                         1 for v, _r in st.replicas if v == st.version
                     ),
                     "autoscaling": st.config.autoscaling is not None,
+                    "restarts": dict(st.restarts),
                 }
                 for name, st in self._deployments.items()
             }
@@ -468,6 +505,7 @@ class _ServeController:
                     ok = self._alive(r)
                     if ok is False:
                         changed = True
+                        _count_replica_restart(st, "death")
                         try:
                             ray_tpu.kill(r)
                         except Exception:
@@ -475,6 +513,45 @@ class _ServeController:
                     else:
                         alive.append((v, r))
                 st.replicas = alive
+                # 2a. proactive health: replicas that ANSWER but report
+                # unhealthy (replica.health -> the callable's
+                # check_health, e.g. the LLM engine's wedged-step-loop
+                # detector) are restarted — liveness alone never catches
+                # a stalled engine whose actor loop still replies. Own
+                # cadence: the 0.25s reconcile pass must not double
+                # every replica's RPC load.
+                period = GLOBAL_CONFIG.serve_replica_health_period_s
+                now_h = time.monotonic()
+                if (
+                    period > 0
+                    and st.replicas
+                    and now_h - st.last_health_ts >= period
+                ):
+                    st.last_health_ts = now_h
+                    healthy: List[Tuple[str, Any]] = []
+                    for v, r in st.replicas:
+                        wedged = False
+                        try:
+                            wedged = (
+                                ray_tpu.get(r.health.remote(), timeout=5)
+                                is False
+                            )
+                        except Exception:
+                            # dead/slow/raising: liveness reaping (above,
+                            # next pass) owns those — restarting on a
+                            # saturated replica's timeout would turn
+                            # overload into an outage
+                            wedged = False
+                        if wedged:
+                            changed = True
+                            _count_replica_restart(st, "unhealthy")
+                            try:
+                                ray_tpu.kill(r)
+                            except Exception:
+                                pass
+                        else:
+                            healthy.append((v, r))
+                    st.replicas = healthy
                 # 2b. preemption handoff: replicas on DRAINING nodes are
                 # unrouted NOW (routers drop them on the next long-poll
                 # push, in-flight requests finish, the drain-kill waits

@@ -25,7 +25,12 @@ Execution semantics (reference ``router.py``): ``execute``/
 replica death re-chooses among the survivors instead of surfacing
 ActorDiedError to the caller (what keeps rolling updates zero-drop).
 The raw ``dispatch`` remains at-most-once for callers that manage
-their own refs.
+their own refs. Streams of methods a deployment declares in
+``resumable_streams`` get the strongest tier: seq-numbered items,
+mid-stream replica death resumed on a survivor with the prompt
+extended by the already-delivered tokens, duplicates suppressed —
+exactly-once token delivery (see ``execute`` for the full three-tier
+contract).
 
 Model multiplexing: a request carrying ``model_id`` prefers replicas
 whose cached stats report that model loaded (reference model-aware
@@ -33,9 +38,12 @@ replica scheduling), falling back to pow-2 over all replicas."""
 
 from __future__ import annotations
 
+import itertools
+import os
 import random
 import threading
 import time
+import uuid
 import weakref
 from typing import Any, Dict, List, Optional
 
@@ -43,9 +51,28 @@ import ray_tpu
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.deadline import Deadline, effective_timeout
 from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
+from ray_tpu.core.rpc import ConnectionLost
+from ray_tpu.core.streaming import SeqGate
 from ray_tpu.observability import tracing as _tracing
 
 _STATS_TTL_S = 0.25
+
+#: failures that mean "the replica is gone", never "the request is bad" —
+#: the only class a resumable stream may fail over on (an app-level
+#: exception from the callable must propagate: replaying it would just
+#: raise it twice)
+_REPLICA_GONE = (ActorDiedError, WorkerCrashedError, ConnectionLost)
+
+#: consecutive zero-progress failover attempts before a resumable stream
+#: gives up: every successful token resets the count, so this only trips
+#: when replicas die faster than they can deliver a single token
+_MAX_BARREN_RESUMES = 5
+
+#: refresh window for the deployment's resumable_streams declaration — a
+#: redeploy can change the callable, and a handle outliving it must not
+#: pin the old contract forever (bounded staleness, one controller call
+#: per window per router)
+_RESUMABLE_META_TTL_S = 30.0
 
 
 def _count_decision(deployment: str, policy: str, affinity_hit: bool = False) -> None:
@@ -57,6 +84,17 @@ def _count_decision(deployment: str, policy: str, affinity_hit: bool = False) ->
     ROUTER_DECISIONS.inc(labels={"deployment": deployment, "policy": policy})
     if affinity_hit:
         ROUTER_AFFINITY_HITS.inc(labels={"deployment": deployment})
+
+
+def _count_stream_resume(deployment: str, replayed_tokens: int) -> None:
+    from ray_tpu.observability.rpc_metrics import (
+        STREAM_RESUME_REPLAY_TOKENS,
+        STREAM_RESUMES,
+    )
+
+    STREAM_RESUMES.inc(labels={"deployment": deployment})
+    if replayed_tokens > 0:
+        STREAM_RESUME_REPLAY_TOKENS.inc(replayed_tokens)
 
 
 def _request_prompt(args) -> Optional[List[int]]:
@@ -125,6 +163,10 @@ class Router:
         self._local_tokens: Dict[Any, float] = {}
         self._poller_started = False
         self._poller_lock = threading.Lock()
+        #: streaming methods the deployment declared replay-safe
+        #: (fetched lazily from the serve controller, cached with a TTL)
+        self._resumable: Optional[frozenset] = None
+        self._resumable_fetched_at = 0.0
         self._closed = False
 
     def close(self) -> None:
@@ -361,25 +403,43 @@ class Router:
         that lands on a dying replica re-chooses. App-level exceptions
         are NOT retried — only replica death/crash.
 
-        RETRY CONTRACT. While the chosen replica is REACHABLE, every
-        call — idempotent or not — is exactly-once-effective: the actor
-        push rides the RPC layer's request-id dedup (core/rpc.py via
-        core_worker request-id reuse), so a lost reply or a transient
-        connection reset is retried transparently and answered from the
-        replica's reply cache instead of re-executing. What remains
-        AT-LEAST-ONCE is replica DEATH: the runtime cannot tell "replica
-        died before it saw the push" apart from "replica executed (part
-        of) the request, then died" — the reply cache died with the
-        process. With ``idempotent=True`` (default) the router
-        re-executes on a survivor either way, so a non-idempotent
-        request (LLM generation, a payment, an append) can run twice
-        after an unlucky crash. Pass ``idempotent=False`` to confine
-        auto-retry to the provably-safe cases (submission-side failure,
-        or the dedup-protected reachable-replica retries above); a
-        post-dispatch replica death then propagates to the caller, who
-        owns the cross-replica dedupe/retry decision. Streaming callers
-        get the tighter contract for free: ``execute_stream`` only ever
-        replays before the first item.
+        RETRY CONTRACT — three tiers, strongest guarantee that each call
+        shape can soundly get:
+
+        1. **Idempotent auto-retry** (``idempotent=True``, the default):
+           retry-until-executed across ANY failure, including replica
+           death. At-least-once — the runtime cannot tell "replica died
+           before it saw the push" apart from "replica executed (part
+           of) the request, then died", so a non-idempotent request (a
+           payment, an append) can run twice after an unlucky crash.
+           Only sound for idempotent handlers.
+        2. **Exactly-once while reachable** (``idempotent=False``):
+           auto-retry is confined to the provably-safe cases. While the
+           chosen replica is REACHABLE every retry rides the RPC layer's
+           request-id dedup (core/rpc.py via core_worker request-id
+           reuse): a lost reply or transient connection reset is
+           answered from the replica's reply cache instead of
+           re-executing. Submission-side failures (the push provably
+           never reached a replica) re-choose freely. A post-dispatch
+           replica DEATH propagates — the reply cache died with the
+           process, so the caller owns the cross-replica decision.
+        3. **Exactly-once token delivery for resumable streams**
+           (``execute_stream`` on methods the deployment declares in
+           its callable's ``resumable_streams``): items carry a
+           per-request monotonic seq; a mid-stream replica death is
+           resumed on a survivor with the original prompt extended by
+           the already-delivered tokens and ``resume_from=seq``, and
+           the SeqGate suppresses boundary duplicates — the
+           client-visible sequence has no gaps and no repeats even
+           across multiple deaths. REPLAY-SAFETY CAVEAT: resume is only
+           sound for side-effect-free DETERMINISTIC generation (same
+           params + request seed + prompt → same items; the engine keys
+           sampling on ``(seed, position)`` for exactly this). A stream
+           with external side effects per item, or nondeterministic
+           items, must not be declared resumable — the replayed prefix
+           would re-run its effects or fork the sequence.
+           Non-resumable streams keep the old contract: replay only
+           before the first item, mid-stream death propagates.
 
         One Deadline covers the whole call (core/deadline.py): dispatch
         retries AND the result get draw from the same budget, clamped by
@@ -419,6 +479,38 @@ class Router:
             f"no replica executed {self._deployment}.{method} in time"
         )
 
+    # -- resumable streams -------------------------------------------------
+    def _resumable_methods(self) -> frozenset:
+        """Streaming methods the deployment's callable declared
+        replay-safe (``resumable_streams`` class attribute), read from
+        the serve controller and cached with a TTL — the declaration is
+        a property of the deployed CODE, which a redeploy can change
+        under a long-lived handle."""
+        cached = self._resumable
+        if (
+            cached is not None
+            and time.monotonic() - self._resumable_fetched_at
+            < _RESUMABLE_META_TTL_S
+        ):
+            return cached
+        try:
+            methods = frozenset(
+                ray_tpu.get(
+                    self._controller.resumable_stream_methods.remote(
+                        self._deployment
+                    ),
+                    timeout=10,
+                )
+            )
+        except Exception:
+            # controller briefly unreachable (failover): serve the stale
+            # cache if there is one, else the legacy contract — and
+            # retry on the next call either way
+            return cached if cached is not None else frozenset()
+        self._resumable = methods
+        self._resumable_fetched_at = time.monotonic()
+        return methods
+
     def execute_stream(
         self,
         method: str,
@@ -428,14 +520,31 @@ class Router:
         model_id: str = "",
         timeout: Optional[float] = 60.0,
     ):
-        """Streaming with dispatch retry: re-chooses if the stream dies
-        BEFORE the first item (nothing was delivered, safe to replay);
-        mid-stream death propagates — replaying would duplicate items.
+        """Streaming with dispatch retry. Two contracts (tier 2 vs tier
+        3 of the ``execute`` docstring):
 
-        The Deadline budget covers dispatch + time-to-first-item; after
+        * methods the deployment declares in ``resumable_streams`` (and
+          whose request is LLM-shaped: a dict with a token ``prompt``)
+          get EXACTLY-ONCE TOKEN DELIVERY — mid-stream replica death is
+          resumed on a survivor with the prompt extended by the
+          already-delivered tokens, duplicates suppressed, no gaps and
+          no repeats across any number of deaths;
+        * everything else re-chooses only if the stream dies BEFORE the
+          first item (nothing was delivered, trivially safe to replay);
+          mid-stream death propagates — replaying would duplicate items.
+
+        The Deadline budget covers dispatch + time-to-first-item (and is
+        re-armed per failover attempt on the resumable path); after
         that, each item get inherits the CALLER's timeout (None = wait
         forever) — a slow producer mid-stream is backpressure, not a
         dispatch failure, so it must not trip a fixed 60s timer."""
+        if method in self._resumable_methods():
+            req = args[0] if args and isinstance(args[0], dict) else None
+            if req is not None and _request_prompt(args) is not None:
+                return self._execute_stream_resumable(
+                    method, req, list(args[1:]), kwargs,
+                    model_id=model_id, timeout=timeout,
+                )
         budget = effective_timeout(timeout)
         deadline = Deadline.after(budget if budget is not None else 3600)
         # per-item patience once streaming: the caller's timeout with any
@@ -480,3 +589,147 @@ class Router:
         raise last_err or TimeoutError(
             f"no replica started stream {self._deployment}.{method} in time"
         )
+
+    def _execute_stream_resumable(
+        self,
+        method: str,
+        req: Dict[str, Any],
+        extra_args: List[Any],
+        kwargs,
+        *,
+        model_id: str = "",
+        timeout: Optional[float] = 60.0,
+    ):
+        """Exactly-once token delivery across replica death (tier 3).
+
+        The request's identity is pinned BEFORE the first dispatch —
+        ``request_id`` and, for sampled generation, an explicit ``seed``
+        — so any replica that (re)runs it derives the identical token
+        stream (engine sampling is keyed on ``(seed, position)``). Every
+        attempt carries ``resume_from`` = the count of tokens already
+        delivered to the client, with the prompt extended by exactly
+        those tokens; replicas answer with ``(seq, token)`` pairs and
+        the SeqGate admits each seq exactly once. The replayed prefix is
+        an exact radix-cache prefix on any replica that served (part of)
+        the stream's deployment traffic, so a warm survivor resumes at
+        near-warm TTFT (bench: ``serve_llm_resume_ttft_p50``)."""
+        budget = effective_timeout(timeout)
+        req = dict(req)
+        req.setdefault("request_id", uuid.uuid4().hex[:16])
+        if req.get("seed") is None and float(req.get("temperature", 0.0)) > 0.0:
+            # sampled generation MUST replay under one pinned seed; the
+            # engine's id-derived fallback seed would also work, but an
+            # explicit stamp survives request_id suffixing across attempts
+            req["seed"] = int.from_bytes(os.urandom(4), "little")
+        base_prompt = [int(t) for t in req["prompt"]]
+        base_rid = str(req["request_id"])
+        gate = SeqGate(0)
+        delivered: List[int] = []
+        item_timeout = budget
+
+        def _gen():
+            attempt = 0
+            barren = 0
+            last_err: Optional[Exception] = None
+            while True:
+                attempt_req = dict(req)
+                attempt_req["resume_from"] = gate.next_seq
+                if attempt:
+                    # replay identity: same logical request, new engine
+                    # intake (a replica that already saw base_rid — e.g.
+                    # one that stalled and recovered — must not reject
+                    # the resume as a duplicate submission)
+                    attempt_req["prompt"] = base_prompt + delivered
+                    attempt_req["request_id"] = f"{base_rid}.r{attempt}"
+                # per-attempt budget: a resume is a fresh dispatch +
+                # time-to-next-token window, not a continuation of the
+                # first attempt's (possibly spent) dispatch budget
+                deadline = Deadline.after(budget if budget is not None else 3600)
+                progress_before = gate.next_seq
+                replica = None
+                try:
+                    try:
+                        replica = self.choose_replica(model_id, [attempt_req])
+                    except RuntimeError as e:
+                        # "no replicas": every candidate died and the
+                        # controller's replacement hasn't registered yet
+                        # — a routing condition, not a stream failure;
+                        # retry under the barren-attempt bound
+                        last_err = e
+                        barren += 1
+                        if barren >= _MAX_BARREN_RESUMES:
+                            raise
+                        attempt += 1
+                        continue
+                    self._bump(replica)
+                    gen = replica.handle_request_streaming.options(
+                        num_returns="streaming"
+                    ).remote(
+                        method, [attempt_req] + extra_args,
+                        dict(kwargs or {}), model_id,
+                    )
+                    first = True
+                    while True:
+                        try:
+                            if first:
+                                # bounded time-to-first(-resumed)-item
+                                ref = gen.next_with_timeout(
+                                    max(1.0, deadline.remaining())
+                                )
+                            else:
+                                # production wait is unbounded, like the
+                                # non-resumable path: a slow producer is
+                                # backpressure, and a DEAD one fails the
+                                # stream (waking this wait) regardless
+                                ref = gen.next_with_timeout(None)
+                        except StopIteration:
+                            return
+                        item = ray_tpu.get(
+                            ref,
+                            timeout=max(1.0, deadline.remaining())
+                            if first
+                            else item_timeout,
+                        )
+                        first = False
+                        try:
+                            seq, token = item
+                        except (TypeError, ValueError):
+                            # a redeploy swapped in a callable that no
+                            # longer speaks the seq protocol while this
+                            # stream (or a stale cache window) was live
+                            raise RuntimeError(
+                                f"resumable stream {self._deployment}."
+                                f"{method} yielded {type(item).__name__}, "
+                                "not a (seq, item) pair — was the "
+                                "deployment redeployed without "
+                                "resumable_streams?"
+                            ) from None
+                        if gate.admit(seq):
+                            delivered.append(token)
+                            barren = 0
+                            yield token
+                except _REPLICA_GONE as e:
+                    last_err = e
+                    if replica is not None:
+                        self._drop_replica(replica)
+                    if gate.next_seq == progress_before:
+                        barren += 1
+                        if barren >= _MAX_BARREN_RESUMES:
+                            raise
+                    attempt += 1
+                    _count_stream_resume(self._deployment, len(delivered))
+                    continue
+
+        # prime the first token eagerly (matching the non-resumable
+        # path: dispatch problems raise at call time, not first next())
+        # under the serve trace root covering dispatch → first item
+        with _tracing.root_span(f"serve::{self._deployment}.{method}", "serve"):
+            g = _gen()
+            try:
+                first_token = next(g)
+            except StopIteration:
+                def _empty():
+                    return
+                    yield  # pragma: no cover
+                return _empty()
+        return itertools.chain([first_token], g)

@@ -160,6 +160,158 @@ class DataFaultPlan:
         return hit
 
 
+class SeededPlanCache:
+    """Process-wide lazy singleton for one env/config-driven seeded
+    fault plan (the shared shape behind ``rpc.active_fault_plan``,
+    ``pull_manager.active_pull_fault_plan`` and
+    ``engine.active_replica_fault_plan``): built once per (spec, seed)
+    config value, rebuilt when either changes, seed generated-and-LOGGED
+    at activation so any failure reproduces from the log alone."""
+
+    def __init__(self, plan_cls, label: str, spec_attr: str, seed_attr: str, logger):
+        self._plan_cls = plan_cls
+        self._label = label
+        self._spec_attr = spec_attr
+        self._seed_attr = seed_attr
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._key: Optional[Tuple[str, int]] = None
+        self._plan = None
+
+    def active(self):
+        """The current plan, or None when the spec knob is empty."""
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        spec = getattr(GLOBAL_CONFIG, self._spec_attr)
+        if not spec:
+            return None
+        key = (spec, getattr(GLOBAL_CONFIG, self._seed_attr))
+        if self._key == key:
+            return self._plan
+        with self._lock:
+            if self._key == key:
+                return self._plan
+            seed = key[1] or (int.from_bytes(os.urandom(4), "little") | 1)
+            plan = self._plan_cls(spec, seed)
+            self._logger.warning(
+                "%s chaos plan ACTIVE: spec=%r seed=%d "
+                "(reproduce: RAY_TPU_%s=%r RAY_TPU_%s=%d)",
+                self._label, spec, seed,
+                self._spec_attr, spec, self._seed_attr, seed,
+            )
+            self._plan, self._key = plan, key
+            return plan
+
+
+#: Replica/engine chaos fault modes (consulted by the LLM engine's step
+#: loop once per executed step phase — see ``inference/engine.py``).
+#: kill_mid_decode — SIGKILL the replica process right before a planned
+#:   decode batch runs: the last emitted token reached (or is in flight
+#:   to) the owner, the next one never samples — the exact boundary the
+#:   router's seq-numbered resume protocol exists for.
+#: kill_mid_prefill — SIGKILL before a planned prefill chunk runs
+#:   (exercises resume before/while the first token is produced).
+#: stall — the step loop sleeps ``param`` seconds mid-step: the actor's
+#:   async loop keeps answering RPCs while the engine wedges, which is
+#:   exactly what the serve controller's health poll (not liveness
+#:   checks) must catch and restart.
+REPLICA_FAULT_MODES = ("kill_mid_decode", "kill_mid_prefill", "stall")
+
+
+class ReplicaFaultPlan:
+    """Seeded replica-death fault plan for LLM serving
+    (``RAY_TPU_testing_replica_chaos``).
+
+    Spec grammar::
+
+        "<mode>:<prob>[:<param>][:<max>][, ...]"
+
+    e.g. ``"kill_mid_decode:1.0:8"`` (deterministically kill on the 9th
+    decode-phase consult) or ``"stall:0.2:5.0:1"``. Fields:
+
+    * ``param`` — for ``stall``: seconds to sleep (default 1.0); for the
+      kill modes: number of matching-phase consults to SKIP before the
+      rule becomes eligible (default 0) — what lets a test land the kill
+      mid-stream instead of on the first token.
+    * ``max`` — injection cap per process (default 1). The plan is
+      usually installed via env/system-config, so EVERY replica —
+      including every controller-spawned replacement — runs the same
+      schedule; an uncapped stall rule would wedge each incarnation
+      forever and the deployment would never converge. (A kill ends the
+      process anyway; the cap matters for ``stall``.)
+
+    Consults happen once per engine-step phase that has work: the engine
+    calls ``consult("prefill")`` when the step runs prefill chunks and
+    ``consult("decode")`` when it runs a decode batch.
+
+    DETERMINISM CONTRACT (same as :class:`RpcFaultPlan`): exactly one
+    RNG draw per consult, whether or not any rule matches — the full
+    injection schedule is a pure function of (seed, the ordered sequence
+    of consulted phases). A failure log carrying the seed plus the spec
+    reproduces the exact fault schedule.
+    """
+
+    def __init__(self, spec: str, seed: int):
+        self.spec = spec
+        self.seed = seed
+        #: [mode, prob, param, max_injections]
+        self.rules: List[List[float]] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) < 2:
+                raise ValueError(
+                    f"bad replica chaos rule {part!r} (need mode:prob)"
+                )
+            mode, prob = fields[0], float(fields[1])
+            if mode not in REPLICA_FAULT_MODES:
+                raise ValueError(
+                    f"unknown replica chaos mode {mode!r} "
+                    f"(one of {REPLICA_FAULT_MODES})"
+                )
+            param = float(fields[2]) if len(fields) > 2 else (
+                1.0 if mode == "stall" else 0.0
+            )
+            cap = int(fields[3]) if len(fields) > 3 else 1
+            self.rules.append([mode, prob, param, cap])
+        self._rng = random.Random(seed)
+        self.consults = 0
+        self.injections = 0
+        #: matching-phase consults seen per rule (the kill-mode skip
+        #: window counts these, not global consults)
+        self._phase_consults = [0] * len(self.rules)
+        self._injected = [0] * len(self.rules)
+
+    @staticmethod
+    def _matches(mode: str, phase: str) -> bool:
+        if mode == "stall":
+            return True
+        return mode == f"kill_mid_{phase}"
+
+    def consult(self, phase: str) -> Optional[Tuple[str, float]]:
+        """One deterministic consult for an engine-step phase
+        (``"prefill"`` | ``"decode"``): ``(mode, param)`` to inject,
+        else None. Exactly one RNG draw regardless of outcome."""
+        draw = self._rng.random()  # ALWAYS one draw (see class docstring)
+        self.consults += 1
+        for i, (mode, prob, param, cap) in enumerate(self.rules):
+            if not self._matches(mode, phase):
+                continue
+            self._phase_consults[i] += 1
+            if mode != "stall" and self._phase_consults[i] <= param:
+                return None  # inside the skip window
+            if self._injected[i] >= cap:
+                return None
+            if draw < prob:
+                self._injected[i] += 1
+                self.injections += 1
+                return (mode, param)
+            return None  # first matching rule owns the draw
+        return None
+
+
 def find_worker_pids(controller_addr: str) -> List[int]:
     """PIDs of worker_main processes attached to ``controller_addr``
     (shared /proc scan: ``util/reaper.py::find_runtime_pids``)."""

@@ -120,6 +120,82 @@ def pytest_report_header(config):
     return lines
 
 
+# ---------------------------------------------------------------------------
+# chaos repro helper: a failure under ANY seeded fault plan prints ONE
+# copy-pasteable env line reproducing that session's full chaos schedule.
+# The seeds already print (report header + activation logs), but the
+# operator had to assemble the env by hand from three knob pairs.
+
+def _activated_plans():
+    """(spec_key, spec, seed_key, seed) for every fault plan that was
+    ACTIVATED in this (driver) process — read from the SeededPlanCache
+    singletons, not GLOBAL_CONFIG: chaos tests restore their config in
+    their own ``finally`` BEFORE the report hook runs, which made the
+    config-only version print nothing for exactly the failures it was
+    built for. The cache keeps the last-activated plan's spec+seed."""
+    out = []
+    probes = (
+        ("ray_tpu.core.rpc", "testing_rpc_chaos"),
+        ("ray_tpu.core.pull_manager", "testing_pull_chaos"),
+        ("ray_tpu.inference.engine", "testing_replica_chaos"),
+    )
+    import importlib
+    import sys as _sys
+
+    for mod_name, spec_key in probes:
+        mod = _sys.modules.get(mod_name)  # never IMPORT here (engine pulls jax)
+        if mod is None:
+            continue
+        cache = getattr(mod, "_PLAN_CACHE", None) or getattr(mod, "_RPLAN_CACHE", None)
+        plan = getattr(cache, "_plan", None)
+        if plan is not None:
+            out.append((spec_key, plan.spec, spec_key + "_seed", plan.seed))
+    return out
+
+
+def _chaos_repro_line(nodeid: str):
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+    entries = {k: (spec, sk, seed) for k, spec, sk, seed in _activated_plans()}
+    # config still carries a plan the driver never consulted (e.g. env
+    # chaos that only child processes run): include it too
+    for spec_key, seed_key in (
+        ("testing_rpc_chaos", "testing_rpc_chaos_seed"),
+        ("testing_pull_chaos", "testing_pull_chaos_seed"),
+        ("testing_replica_chaos", "testing_replica_chaos_seed"),
+    ):
+        spec = getattr(cfg, spec_key)
+        if spec and spec_key not in entries:
+            entries[spec_key] = (spec, seed_key, getattr(cfg, seed_key))
+    if not entries:
+        return None
+    parts = []
+    for spec_key, (spec, seed_key, seed) in entries.items():
+        parts.append(f"RAY_TPU_{spec_key}={spec!r}")
+        if seed:
+            parts.append(f"RAY_TPU_{seed_key}={seed}")
+    return (
+        " ".join(parts)
+        + f" python -m pytest '{nodeid}'"
+        + "  # replays this session's seeded fault schedule"
+        + " (a child process that GENERATED its own seed logs it at"
+        + " plan activation — substitute that value)"
+    )
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if report.when == "call" and report.failed:
+        try:
+            line = _chaos_repro_line(item.nodeid)
+        except Exception:
+            line = None
+        if line:
+            report.sections.append(("chaos repro", line))
+
+
 @pytest.fixture
 def ray_start_local():
     ray_tpu.init(local_mode=True)

@@ -34,6 +34,10 @@ from ray_tpu.core.api import _global_worker
 from ray_tpu.core.cluster_backend import _stop, spawn_controller, spawn_node
 from ray_tpu.core.config import GLOBAL_CONFIG
 
+# seeded fault-injection suite: a failure prints the copy-pasteable
+# RAY_TPU_testing_* repro line (tests/conftest.py chaos helper)
+pytestmark = pytest.mark.chaos
+
 #: seeded fault plan: reply_drop on the control plane's mutating methods
 #: (the dedup-required class from the issue: actor create, kv_put, node
 #: register, death reports) plus the worker push path (submit/serve

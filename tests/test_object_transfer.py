@@ -639,16 +639,44 @@ def test_e2e_source_node_killed_mid_transfer():
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
 
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
     cluster = None
+    # replacement capacity can take tens of seconds to spawn and register
+    # on a loaded box; the DEFAULT 30s client-side infeasible window was a
+    # load-sensitive race — retried `produce` tasks needing "src" must
+    # keep waiting for the replacement node, exactly the autoscaled-
+    # cluster contract this knob exists for. (Client-side knob: set on
+    # the driver's GLOBAL_CONFIG, like test_drain's grace override.)
+    old_infeasible = GLOBAL_CONFIG.infeasible_fail_after_s
+    GLOBAL_CONFIG.infeasible_fail_after_s = 120.0
     try:
         cluster = Cluster(num_cpus=2)
         n2 = cluster.add_node(num_cpus=2, resources={"src": 8})
         time.sleep(1.0)
         ray_tpu.init(address=cluster.address)
 
-        @ray_tpu.remote(max_retries=5, resources={"src": 1})
+        # num_cpus=0: the root cause of this test's load-flakiness was a
+        # SCHEDULING DEADLOCK, not transfer timing — workers do not
+        # release their CPU while blocked (the reference frees a
+        # blocked worker's resources during get/arg-fetch; see README
+        # "Known gaps"), so after the kill every CPU could be held by
+        # consume tasks parked in arg-fetch awaiting reconstruction,
+        # while the reconstructed produce tasks needed a CPU to run:
+        # whether the run completed was a lease-ordering race. Making
+        # produce CPU-free decouples it from the blocked consumers, so
+        # recovery is deadlock-free BY CONSTRUCTION — without touching
+        # the transfer-failover + lineage machinery under test.
+        @ray_tpu.remote(max_retries=5, num_cpus=0, resources={"src": 1})
         def produce(i):
-            time.sleep(0.3)  # stagger production so the kill lands mid-stream
+            # STAGGERED durations (0.3s..3s): a flat sleep lets the whole
+            # wave finish together, so any completion-based kill trigger
+            # strands EVERY output on the dying node — and each stranded
+            # object costs a serial ~10s dead-source connect probe in
+            # recovery, which blows the get budget. With per-task sleeps
+            # dominating, "2 produced" provably means "most still
+            # mid-run" on any box speed.
+            time.sleep(0.3 * (i + 1))
             return np.full((512 * 1024,), float(i), dtype=np.float64)  # 4 MiB
 
         @ray_tpu.remote(max_retries=5, num_cpus=0.5)
@@ -659,7 +687,16 @@ def test_e2e_source_node_killed_mid_transfer():
         sums = [consume.remote(r) for r in refs]
 
         def _kill_and_replace():
-            time.sleep(1.2)  # let production + transfers start
+            # condition-based timing (the wall-clock 1.2s sleep this
+            # replaces raced box load both ways: kill before anything
+            # produced = plain full reconstruction with no transfer in
+            # flight, kill after everything consumed = no fault at all):
+            # wait until the FIRST produce outputs exist on the source —
+            # their transfers to consumers are starting right now, while
+            # later (longer-sleeping) producers are provably still
+            # mid-run, so the kill exercises BOTH transfer failover and
+            # in-flight task retry without stranding every output
+            ray_tpu.wait(list(refs), num_returns=2, timeout=60)
             cluster.remove_node(n2)  # SIGKILL the whole node group
             # replacement capacity so lineage reconstruction of lost
             # producer outputs has somewhere to run
@@ -668,10 +705,11 @@ def test_e2e_source_node_killed_mid_transfer():
         killer = threading.Thread(target=_kill_and_replace, daemon=True)
         killer.start()
         results = ray_tpu.get(sums, timeout=150)
-        killer.join(timeout=30)
+        killer.join(timeout=60)
         expect = [float(i) * 512 * 1024 for i in range(10)]
         assert results == expect, (results, expect)
     finally:
+        GLOBAL_CONFIG.infeasible_fail_after_s = old_infeasible
         try:
             ray_tpu.shutdown()
         finally:
