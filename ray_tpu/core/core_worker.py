@@ -175,6 +175,16 @@ class CoreWorker(RuntimeBackend):
         self._task_events: List[Dict[str, Any]] = []
         self._task_events_lock = threading.Lock()
         self._task_events_flushing = False
+        # blocked-worker resource release (satellite of the zero-copy
+        # data plane PR; reference NotifyDirectCallTaskBlocked): worker
+        # processes tell their daemon when a get is about to PARK so the
+        # daemon can lend the held CPUs out, and again on wake. Depth-
+        # counted — concurrent lane threads blocking notify once.
+        self._spawn_token = (
+            os.environ.get("RAY_TPU_SPAWN_TOKEN", "") if executor is not None else ""
+        )
+        self._blocked_depth = 0
+        self._blocked_lock = threading.Lock()
 
         async def _setup():
             self.server = RpcServer()
@@ -316,6 +326,45 @@ class CoreWorker(RuntimeBackend):
                 category="task",
             )
 
+    def _worker_blocked_scope(self):
+        """Context manager bracketing a blocking wait inside a WORKER
+        process: on entry (outermost only) the daemon releases the CPU
+        share of this worker's lease so other tasks — e.g. the producer
+        this get waits on — can run; on exit it re-acquires. No-op for
+        drivers and when disabled. Notification loss is safe: the daemon
+        self-heals accounting at lease release."""
+        import contextlib
+
+        if not self._spawn_token or not GLOBAL_CONFIG.blocked_worker_resource_release:
+            return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def scope():
+            with self._blocked_lock:
+                self._blocked_depth += 1
+                notify = self._blocked_depth == 1
+            if notify:
+                self._notify_daemon_blocked("worker_blocked")
+            try:
+                yield
+            finally:
+                with self._blocked_lock:
+                    self._blocked_depth -= 1
+                    notify = self._blocked_depth == 0
+                if notify:
+                    self._notify_daemon_blocked("worker_unblocked")
+
+        return scope()
+
+    def _notify_daemon_blocked(self, method: str) -> None:
+        try:
+            self.io.run(
+                self.daemon.call(method, {"token": self._spawn_token}, timeout=5),
+                timeout=10,
+            )
+        except Exception:
+            logger.debug("%s notification failed", method, exc_info=True)
+
     def _get_objects_inner(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
         # Sync fast path for owned refs: resolve on the CALLING thread —
@@ -337,7 +386,15 @@ class CoreWorker(RuntimeBackend):
             remaining = (
                 None if deadline is None else max(0.0, deadline - time.monotonic())
             )
-            obj = self.refcounter.wait_ready(oid, remaining)
+            obj = self.refcounter.get(oid)
+            if obj is not None and obj.ready():
+                pass  # no park coming: skip the blocked notification
+            else:
+                # about to PARK this worker thread: lend the held CPUs
+                # out for the duration (deadlock defense — the producer
+                # we wait on may need them)
+                with self._worker_blocked_scope():
+                    obj = self.refcounter.wait_ready(oid, remaining)
             if obj is None or not obj.ready():
                 raise GetTimeoutError(f"get() timed out waiting for {oid.hex()[:12]}")
             if obj.state == ObjState.FAILED:
@@ -356,7 +413,11 @@ class CoreWorker(RuntimeBackend):
         async def _get_all():
             return await asyncio.gather(*[self._get_one(r, deadline) for r in rest])
 
-        return out + self.io.run(_get_all())
+        # the async path may fetch across nodes / wait on borrowed
+        # owners: treat it as a potential park (the lend/re-acquire pair
+        # costs two sub-ms daemon RPCs, noise next to any real fetch)
+        with self._worker_blocked_scope():
+            return out + self.io.run(_get_all())
 
     async def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
         oid = ref.id()

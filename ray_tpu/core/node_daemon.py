@@ -65,6 +65,12 @@ class WorkerProc:
     conn: Optional[ServerConnection] = None
     client: Optional[RpcClient] = None
     idle_since: float = 0.0  # monotonic ts when last parked in the idle pool
+    # CPU resources this worker holds that are currently RELEASED back to
+    # the node pool because it blocks in a sync get/arg-fetch (reference:
+    # NotifyDirectCallTaskBlocked). Stays set past an unblock that can't
+    # re-acquire (bounded oversubscription); the lease/actor release
+    # withholds exactly this amount so accounting always balances.
+    blocked_released: Optional[Dict[str, float]] = None
 
 
 @dataclass
@@ -1086,6 +1092,73 @@ class NodeDaemon:
         self._release_lease(payload["lease_id"])
         return True
 
+    # ---- blocked-worker resource release (reference raylet
+    # NotifyDirectCallTaskBlocked/Unblocked) --------------------------------
+    # A worker parked in a sync get/arg-fetch holds CPUs it cannot use —
+    # the PR 10 scheduling deadlock: every CPU held by consume tasks
+    # blocked on producers that NEED a CPU to (re)run. While blocked, the
+    # CPU share of the worker's lease (or actor allocation) goes back to
+    # the node pool; on wake it is re-acquired when it fits, otherwise
+    # the task finishes briefly oversubscribed and the lease release
+    # withholds the already-returned amount. TPU chips are never
+    # released: a chip-bound process can't lend its chips.
+
+    def _worker_held_node_resources(self, w: WorkerProc) -> Optional[Dict[str, float]]:
+        """The resources ``w`` holds from the NODE pool (bundle-pool
+        allocations are excluded — a PG bundle's capacity is not the
+        node's to lend)."""
+        if w.actor_id is not None:
+            if w.actor_resources is not None and w.actor_bundle_key is None:
+                return w.actor_resources
+            return None
+        for lease in self.leases.values():
+            if lease.worker is w and lease.bundle_key is None:
+                return lease.resources
+        return None
+
+    async def d_worker_blocked(self, payload, conn):
+        """The worker entered a blocking sync get/arg-fetch: release the
+        CPU share of what it holds so other work (e.g. the producer it
+        waits on) can be scheduled here. Idempotent per block episode."""
+        if not GLOBAL_CONFIG.blocked_worker_resource_release:
+            return False
+        w = self.workers.get(payload.get("token", ""))
+        if w is None or w.blocked_released is not None:
+            return False
+        held = self._worker_held_node_resources(w)
+        cpu = (held or {}).get("CPU", 0.0)
+        if cpu <= 0:
+            return False
+        rel = {"CPU": cpu}
+        self.resources.release(ResourceSet(rel))
+        w.blocked_released = rel
+        self._notify_capacity()
+        return True
+
+    async def d_worker_unblocked(self, payload, conn):
+        """The worker woke up: re-acquire the released CPUs when they
+        fit. When they don't (another task took them meanwhile), the
+        task continues oversubscribed and the eventual lease/actor
+        release withholds the debt — accounting self-heals even if this
+        RPC is lost entirely."""
+        w = self.workers.get(payload.get("token", ""))
+        if w is None or w.blocked_released is None:
+            return False
+        rel = ResourceSet(w.blocked_released)
+        if self.resources.can_fit(rel):
+            self.resources.allocate(rel)
+            w.blocked_released = None
+            return True
+        return False
+
+    def _withhold_blocked_release(self, w: WorkerProc, req: ResourceSet) -> ResourceSet:
+        """Subtract the CPUs already returned to the pool while ``w``
+        blocked from what a lease/actor release would give back."""
+        if w.blocked_released is None:
+            return req
+        rel, w.blocked_released = w.blocked_released, None
+        return req.subtract(ResourceSet(rel), allow_negative=True)
+
     def _release_lease(self, lease_id: int) -> None:
         lease = self.leases.pop(lease_id, None)
         if lease is None:
@@ -1096,7 +1169,9 @@ class NodeDaemon:
             if pool is not None:
                 pool.release(req)
         else:
-            self.resources.release(req)
+            self.resources.release(
+                self._withhold_blocked_release(lease.worker, req)
+            )
         w = lease.worker
         w.leased = False
         self._notify_capacity()
@@ -1166,7 +1241,7 @@ class NodeDaemon:
             if pool is not None:
                 pool.release(req)
         else:
-            self.resources.release(req)
+            self.resources.release(self._withhold_blocked_release(w, req))
         self._notify_capacity()
 
     async def d_kill_worker(self, payload, conn):
@@ -1267,14 +1342,33 @@ class NodeDaemon:
         return {"size": meta[1], "digest": digest}
 
     async def d_fetch_chunk(self, payload, conn):
+        """One transfer chunk. A receiver that stamps ``raw: True`` gets
+        a RAW frame: the payload is written to the socket straight from
+        this node's mapped segment (scatter-gather, no per-chunk bytes
+        copy) with the crc riding the frame header; the receiver reads
+        it directly into its destination segment and verifies there.
+        Legacy receivers get the pickled ``(bytes, crc)`` tuple."""
+        import zlib
+
         object_id = ObjectID(payload["object_id"])
+        if payload.get("raw"):
+            from ray_tpu.core.rpc import RawPayload
+
+            win = self.store.read_window(
+                object_id, payload["offset"], payload["length"]
+            )
+            if win is None:
+                raise KeyError(f"object {object_id.hex()[:12]} not here")
+            # crc over the mapped view — computed by the sender so a
+            # corrupt wire byte (or segment) is caught receiver-side
+            # before the chunk commits
+            return RawPayload(win.view, meta=zlib.crc32(win.view), close=win.close)
         data = self.store.read_range(object_id, payload["offset"], payload["length"])
         if data is None:
             raise KeyError(f"object {object_id.hex()[:12]} not here")
-        # per-chunk crc: the receiver verifies BEFORE the bytes touch its
-        # destination segment (a corrupt chunk is re-fetched, not served)
-        import zlib
-
+        # per-chunk crc: the receiver verifies BEFORE the bytes commit to
+        # its destination segment (a corrupt chunk is re-fetched, not
+        # served)
         return (data, zlib.crc32(data))
 
     async def d_delete_object(self, payload, conn):

@@ -115,6 +115,37 @@ class ObjectStoreFull(Exception):
     pass
 
 
+class SegmentWindow:
+    """A memoryview over (a range of) a store segment plus the attachment
+    keeping it valid — the zero-copy unit of the data plane. Senders get
+    read windows (``read_window``) and write them straight to the socket;
+    receivers get the writable window into an UNSEALED entry
+    (``receive_window``) and read chunk payloads directly into it.
+
+    ``close()`` releases the view then the mapping; it tolerates live
+    sub-views (a late in-flight receive still holding a slice) by leaving
+    the mapping open — the process-lifetime leak of one mapping beats a
+    BufferError masking a transfer result."""
+
+    __slots__ = ("_seg", "view")
+
+    def __init__(self, seg: shared_memory.SharedMemory, view: memoryview):
+        self._seg = seg
+        self.view = view
+
+    def __len__(self) -> int:
+        return len(self.view)
+
+    def close(self) -> None:
+        try:
+            self.view.release()
+            self._seg.close()
+        except BufferError:
+            logger.debug("segment window closed with live sub-views; mapping kept")
+        except Exception:
+            pass
+
+
 @dataclass
 class _Entry:
     size: int
@@ -304,6 +335,24 @@ class ShmStore:
             except FileNotFoundError:
                 pass
 
+    def receive_window(self, object_id: ObjectID) -> SegmentWindow:
+        """The writable window into an UNSEALED entry (an in-flight
+        transfer's destination segment): the pull manager reads verified
+        chunk payloads straight into it — zero intermediate copies. Only
+        the receiving transfer may hold this window; every reader path
+        still denies the object until ``seal_receive``. Raises KeyError
+        when no unsealed entry exists (never exposes sealed objects as
+        writable)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or e.sealed:
+                raise KeyError(
+                    f"no unsealed receive entry for {object_id.hex()[:12]}"
+                )
+            size = e.size
+        seg = _attach(segment_name(object_id))
+        return SegmentWindow(seg, memoryview(seg.buf)[:size])
+
     def peek_digest(self, object_id: ObjectID) -> Optional[int]:
         """Cached digest only — never computes (cheap probe-path check)."""
         with self._lock:
@@ -452,6 +501,25 @@ class ShmStore:
             return bytes(seg.buf[offset:end])
         finally:
             seg.close()
+
+    def read_window(
+        self, object_id: ObjectID, offset: int, length: int
+    ) -> Optional[SegmentWindow]:
+        """Zero-copy chunk view (transfer send path): the returned window
+        is written to the socket straight from the mapped segment — no
+        per-chunk ``bytes`` copy. The caller closes it once the transport
+        has consumed the buffer (RawPayload's close hook). Restores from
+        spill like :meth:`read_range`; None if unknown."""
+        meta = self.ensure_local(object_id)
+        if meta is None:
+            return None
+        name, size = meta
+        try:
+            seg = _attach(name)
+        except FileNotFoundError:
+            return None  # raced a spill/delete; caller retries
+        end = min(size, offset + length)
+        return SegmentWindow(seg, memoryview(seg.buf)[offset:end])
 
     def pin(self, object_id: ObjectID) -> None:
         with self._lock:

@@ -5,21 +5,25 @@ treats node-to-node transfer as a first-class fault domain: admission
 control over in-flight pull bytes, chunked pipelining, retry on source
 loss. This module is that subsystem for the shm store:
 
-* **Streaming shm writes** — the destination segment is allocated up
-  front and chunks are written directly into it (no whole-object heap
-  buffer). The store entry stays UNSEALED for the duration: readers
-  (``contains``/``ensure_local``/``read_*``) never see a partial
-  object; a failed transfer aborts the uncommitted segment.
+* **Zero-copy streaming shm writes** — the destination segment is
+  allocated up front and RAW chunk replies (core/rpc.py kind 5) are
+  received DIRECTLY into its writable window (no whole-object heap
+  buffer, no per-chunk intermediate ``bytes``); the running crc folds
+  over the received view. The store entry stays UNSEALED for the
+  duration: readers (``contains``/``ensure_local``/``read_*``) never
+  see a partial object; a failed transfer aborts the uncommitted
+  segment.
 * **Resumable multi-source transfer** — per-chunk timeout/retry with
   jittered backoff capped by the ambient ``core/deadline``; when a
   source dies or drains mid-pull the transfer fails over to the next
   source and RESUMES from the last verified offset — a lost source
   costs one chunk, not the object.
 * **End-to-end integrity** — every chunk carries a crc32 computed by
-  the sender and is verified before it touches the destination segment
-  (mismatch → re-fetch); the whole-object digest carried with
-  ``object_info`` is verified before seal. A corrupt or truncated chunk
-  can never be served to a reader.
+  the sender and is verified before it COMMITS (a RAW payload occupies
+  its reader-invisible destination range while the crc is checked in
+  place; mismatch → re-fetch into the same range); the whole-object
+  digest carried with ``object_info`` is verified before seal. A
+  corrupt or truncated chunk can never be served to a reader.
 * **Admission control + single-flight** — a bounded in-flight-bytes
   budget (``pull_max_inflight_bytes``) with strict FIFO queueing, so N
   concurrent pulls backpressure instead of OOMing the daemon; an object
@@ -51,10 +55,11 @@ from typing import Deque, Dict, Optional, Tuple
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.deadline import effective_timeout
 from ray_tpu.core.ids import ObjectID
-from ray_tpu.core.object_store import ShmStore, _attach
+from ray_tpu.core.object_store import ShmStore
 from ray_tpu.core.rpc import ConnectionLost
 from ray_tpu.core.transport_retry import backoff_sleep
 from ray_tpu.observability import tracing as _tracing
+from ray_tpu.util.crc import crc32_combine
 
 logger = logging.getLogger(__name__)
 
@@ -364,7 +369,7 @@ class PullManager:
         size, digest = head["size"], head.get("digest")
         admitted = False
         allocated = False
-        seg = None
+        win = None
         try:
             admit_t0 = time.monotonic()
             await self._admit(size)
@@ -379,10 +384,12 @@ class PullManager:
                 meta = self.store.ensure_local(object_id)
                 if meta is not None:
                     return {"segment": meta[0], "size": meta[1]}
-            name = self.store.allocate_receive(object_id, size)
+            self.store.allocate_receive(object_id, size)
             allocated = True
-            seg = _attach(name)
-            buf = seg.buf
+            # the writable window into the unsealed entry: RAW chunk
+            # replies are received STRAIGHT into it (zero-copy receive)
+            win = self.store.receive_window(object_id)
+            buf = win.view
             offset, crc = 0, 0
             transfer_t0 = time.monotonic()
             while True:
@@ -438,8 +445,8 @@ class PullManager:
                 "causes": causes,
             }
         finally:
-            if seg is not None:
-                seg.close()
+            if win is not None:
+                win.close()
             if allocated:
                 self.store.abort_receive(object_id)  # no-op once sealed
             if admitted:
@@ -456,13 +463,23 @@ class PullManager:
         plan,
     ) -> Tuple[int, int]:
         """Stream chunks from one source into the destination segment
-        starting at ``offset``. Returns the final (offset, crc) on
+        starting at ``offset``. RAW replies land DIRECTLY in ``buf``'s
+        chunk range (zero-copy receive); legacy pickled replies are
+        copied in at commit. Returns the final (offset, crc) on
         completion; raises :class:`_SourceFailed` with progress already
-        durable in ``buf`` (the caller resumes elsewhere)."""
+        durable in ``buf`` (the caller resumes elsewhere).
+
+        Visibility note: unverified bytes may transiently exist in the
+        unsealed destination window (a RAW payload is written by the
+        transport before its crc is checked), but a chunk only COMMITS —
+        advancing offset and the running crc — after verification, and
+        the entry stays invisible to every reader until seal. A failed
+        check re-fetches into the same range."""
         from ray_tpu.observability.rpc_metrics import (
             PULL_CHUNK_RETRIES,
             PULL_CHUNKS,
             PULL_INTEGRITY_FAILURES,
+            PULL_RAW_CHUNKS,
         )
 
         client = self._peer(src[0], src[1])
@@ -480,7 +497,8 @@ class PullManager:
                     ln = min(chunk_bytes, size - next_sched)
                     inflight[next_sched] = asyncio.ensure_future(
                         self._fetch_chunk_once(
-                            client, object_id, next_sched, ln, plan
+                            client, object_id, next_sched, ln, plan,
+                            into=buf[next_sched : next_sched + ln],
                         )
                     )
                     next_sched += ln
@@ -494,7 +512,8 @@ class PullManager:
                             data = await task
                         else:
                             data = await self._fetch_chunk_once(
-                                client, object_id, offset, length, plan
+                                client, object_id, offset, length, plan,
+                                into=buf[offset : offset + length],
                             )
                         break
                     except _ChunkIntegrityError:
@@ -529,10 +548,22 @@ class PullManager:
                             "deadline exhausted mid-transfer", deadline=True
                         )
                 # chunk verified: commit it. Only now does the running crc
-                # advance — a failover resumes exactly from here.
-                buf[offset : offset + len(data)] = data
-                crc = zlib.crc32(data, crc)
-                offset += len(data)
+                # advance — a failover resumes exactly from here. The fold
+                # uses crc32_combine over the already-VERIFIED chunk crc:
+                # one matrix·vector multiply instead of a second full data
+                # pass (util/crc.py) — the receiver touches each byte
+                # exactly once.
+                ln, chunk_crc, data = data
+                if data is not None:
+                    # legacy pickled reply: one copy into the window
+                    buf[offset : offset + ln] = data
+                else:
+                    # counted at COMMIT, beside PULL_CHUNKS, so the
+                    # raw==total tripwire holds even when a failover
+                    # discards verified-but-uncommitted prefetches
+                    PULL_RAW_CHUNKS.inc()
+                crc = crc32_combine(crc, chunk_crc, ln)
+                offset += ln
                 PULL_CHUNKS.inc()
             return offset, crc
         finally:
@@ -544,10 +575,22 @@ class PullManager:
                 await asyncio.gather(*inflight.values(), return_exceptions=True)
 
     async def _fetch_chunk_once(
-        self, client, object_id: ObjectID, offset: int, length: int, plan
-    ) -> bytes:
+        self, client, object_id: ObjectID, offset: int, length: int, plan,
+        into=None,
+    ):
         """One chunk attempt: chaos consult, bounded fetch, per-chunk
-        integrity verification. Never writes unverified bytes anywhere."""
+        integrity verification. RAW replies are received straight into
+        ``into`` (a writable sub-view of the destination window) and
+        verified THERE. Returns ``(length, verified_chunk_crc, data)``
+        where ``data`` is None for RAW receives (payload already in the
+        window) and the verified bytes for legacy pickled replies — the
+        caller commits by folding the VERIFIED crc (no second data
+        pass). Unverified bytes never COMMIT anywhere — a RAW payload
+        transiently occupies its (unsealed, reader-invisible)
+        destination range until its crc passes, and a failed check
+        re-fetches into the same range."""
+        from ray_tpu.core.rpc import RawReply
+
         mode = param = None
         if plan is not None:
             fault = plan.next_fault()
@@ -572,10 +615,39 @@ class PullManager:
                 "object_id": object_id.binary(),
                 "offset": offset,
                 "length": length,
+                # announce zero-copy receive: a RAW-capable source answers
+                # with an out-of-band payload framed for ``into``
+                "raw": into is not None,
             },
             timeout=timeout,
+            raw_into=into,
         )
-        if isinstance(reply, (bytes, bytearray, memoryview)):
+        if isinstance(reply, RawReply):
+            chunk_crc = reply.meta
+            if reply.data is None and into is not None:
+                # zero-copy receive: payload already sits in the
+                # destination range — verify it in place (the receiver's
+                # ONLY pass over the bytes)
+                view = into[: reply.nbytes]
+                if mode == "chunk_corrupt" and reply.nbytes:
+                    # flip one byte AFTER the sender computed the crc: the
+                    # verification below MUST catch it (that's the assertion)
+                    view[reply.nbytes // 2] ^= 0xFF
+                verified = zlib.crc32(view)
+                if chunk_crc is not None and verified != chunk_crc:
+                    raise _ChunkIntegrityError(
+                        f"chunk crc mismatch at offset {offset}"
+                    )
+                if reply.nbytes != length:
+                    raise _ChunkIntegrityError(
+                        f"truncated chunk at offset {offset}: "
+                        f"{reply.nbytes} != {length}"
+                    )
+                return reply.nbytes, verified, None
+            # sink-less raw fallback (shouldn't happen on this path):
+            # treat like a legacy reply
+            data = bytes(reply.data or b"")
+        elif isinstance(reply, (bytes, bytearray, memoryview)):
             data, chunk_crc = bytes(reply), None  # legacy sender (no crc)
         else:
             data, chunk_crc = reply
@@ -585,10 +657,11 @@ class PullManager:
             corrupted = bytearray(data)
             corrupted[len(corrupted) // 2] ^= 0xFF
             data = bytes(corrupted)
-        if chunk_crc is not None and zlib.crc32(data) != chunk_crc:
+        verified = zlib.crc32(data)
+        if chunk_crc is not None and verified != chunk_crc:
             raise _ChunkIntegrityError(f"chunk crc mismatch at offset {offset}")
         if len(data) != length:
             raise _ChunkIntegrityError(
                 f"truncated chunk at offset {offset}: {len(data)} != {length}"
             )
-        return data
+        return len(data), verified, data

@@ -17,9 +17,32 @@ seq identifies the subscription), 4=batch (micro-batching: the payload
 slot carries a FIFO list of packed sub-frame bodies — a flush coalesces
 every frame queued on a connection into batch frames, and the receiver
 dispatches all of them from ONE read wakeup instead of a wakeup per
-frame; per-connection FIFO order is preserved).
+frame; per-connection FIFO order is preserved), 5=raw (zero-copy bulk
+payload framing, below).
 Payloads are pickled (cloudpickle-compatible dataclasses travel as-is);
-the store's bulk data paths use raw bytes to avoid copies.
+the store's bulk data paths use RAW frames to avoid copies.
+
+RAW frames (kind 5) — the zero-copy data plane. The header stays a
+length-prefixed msgpack body, but the bulk payload travels OUT OF BAND
+as raw bytes immediately after it:
+
+    [u32 header_len] [msgpack: [5, seq, method, payload_len, meta]]
+    [payload_len raw bytes]
+
+The sender never concatenates header and payload: ``send_raw`` queues
+the header plus the payload ``memoryview`` and the flush writes them
+back to back (writev-style scatter-gather — the payload goes to the
+socket straight from its source buffer, e.g. a shm segment). The
+receiver reads ``payload_len`` bytes off the stream DIRECTLY into a
+caller-provided buffer (``call(..., raw_into=view)``), so a chunk reply
+lands in the destination shm segment with zero intermediate full-size
+``bytes``. A non-empty ``method`` marks a RAW *reply* (seq matches a
+pending call); an empty method marks a RAW *push* (seq is the
+subscription channel, meta is the pickled envelope dict — the payload
+is delivered as ``envelope["data"]``). RAW frames never batch, and RAW
+replies never enter the request-dedup reply cache (one multi-MiB bulk
+reply would evict the entire 32 MiB control-plane window) — the bulk
+methods are idempotent reads, so a retried RAW call simply re-executes.
 
 Exactly-once-effective mutating RPCs: a lost *reply* is
 indistinguishable from a lost *request*, so a blind retry of a mutating
@@ -44,6 +67,7 @@ import os
 import pickle
 import random
 import struct
+import sys
 import threading
 import time
 from collections import OrderedDict
@@ -57,9 +81,15 @@ from ray_tpu.observability import tracing as _tracing
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<I")
-REQUEST, REPLY_OK, REPLY_ERR, PUSH, BATCH = 0, 1, 2, 3, 4
+REQUEST, REPLY_OK, REPLY_ERR, PUSH, BATCH, RAW = 0, 1, 2, 3, 4, 5
 
 MAX_FRAME = 1 << 31
+
+#: receive-loop copy granularity for out-of-band RAW payloads: each
+#: ``reader.read`` returns at most one buffer of roughly this size which
+#: is immediately copied into the destination view — bounded transient
+#: allocations, never a full-payload bytes object
+_RAW_READ_CHUNK = 1 << 20
 
 
 #: corked writes flush early past this many buffered bytes (keeps
@@ -115,6 +145,9 @@ IDEMPOTENT_METHODS: Dict[str, frozenset] = {
             # idempotent-by-construction object/worker ops
             "pull_object", "adopt_object", "delete_object",
             "kill_worker", "return_lease",
+            # idempotently guarded (per-worker released-state latch):
+            # blind retries re-observe, never double-release
+            "worker_blocked", "worker_unblocked",
             # drain entry point is idempotently guarded
             "drain",
         }
@@ -175,6 +208,47 @@ class ChaosInjectedError(ConnectionLost):
     makes the retry safe for mutating methods."""
 
 
+#: Linux-only privileged setsockopt variants that bypass wmem_max/rmem_max
+_SO_SNDBUFFORCE = 32
+_SO_RCVBUFFORCE = 33
+
+
+def _tune_transport(writer: asyncio.StreamWriter) -> None:
+    """Best-effort per-connection throughput tuning: big kernel socket
+    buffers (so a multi-MiB RAW payload goes to the kernel in one send
+    instead of being memcpy'd into the asyncio write buffer) and a
+    matching transport write high-water mark (fewer drain round-trips).
+    Failures are ignored — the connection works either way, just slower."""
+    import socket as _socket
+
+    buf = GLOBAL_CONFIG.rpc_socket_buffer_bytes
+    if buf <= 0:
+        return
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        # the FORCE variants are Linux-only option NUMBERS — on other
+        # platforms 32/33 name unrelated options (e.g. SO_BROADCAST on
+        # BSD/macOS), so never issue them there
+        is_linux = sys.platform.startswith("linux")
+        for force_opt, opt in (
+            (_SO_SNDBUFFORCE if is_linux else None, _socket.SO_SNDBUF),
+            (_SO_RCVBUFFORCE if is_linux else None, _socket.SO_RCVBUF),
+        ):
+            try:
+                if force_opt is None:
+                    raise OSError
+                sock.setsockopt(_socket.SOL_SOCKET, force_opt, buf)
+            except OSError:
+                try:
+                    sock.setsockopt(_socket.SOL_SOCKET, opt, buf)
+                except OSError:
+                    pass
+    try:
+        writer.transport.set_write_buffer_limits(high=buf)
+    except Exception:
+        pass
+
+
 def _chaos_should_fail(method: str) -> bool:
     """Legacy pre-handler fault injection (reference
     ``RAY_testing_rpc_failure``)."""
@@ -226,6 +300,105 @@ def _count_injection(mode: str) -> None:
     from ray_tpu.observability.rpc_metrics import RPC_CHAOS_INJECTIONS
 
     RPC_CHAOS_INJECTIONS.inc(labels={"mode": mode})
+
+
+class RawPayload:
+    """A handler's (or push sender's) zero-copy bulk reply: ``payload``
+    is any buffer (bytes / bytearray / memoryview — typically a window
+    into a shm segment), ``meta`` is a small msgpack-able header riding
+    the RAW frame (e.g. a chunk crc), ``close`` is invoked exactly once
+    after the payload has been handed to the transport (the hook that
+    releases the source segment window)."""
+
+    __slots__ = ("payload", "meta", "_close")
+
+    def __init__(self, payload, meta=None, close: Optional[Callable[[], None]] = None):
+        self.payload = payload
+        self.meta = meta
+        self._close = close
+
+    def release(self) -> None:
+        close, self._close = self._close, None
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                logger.debug("RawPayload close hook failed", exc_info=True)
+
+
+class RawReply:
+    """Client-side result of a call answered with a RAW frame.
+
+    ``nbytes`` bytes were received; when the caller supplied a sink
+    (``raw_into``) they were written straight into it and ``data`` is
+    None; otherwise ``data`` holds the payload (the no-sink fallback —
+    one materialization, same as the legacy path). ``meta`` is the
+    sender's RAW header metadata (e.g. the chunk crc)."""
+
+    __slots__ = ("nbytes", "meta", "data")
+
+    def __init__(self, nbytes: int, meta=None, data=None):
+        self.nbytes = nbytes
+        self.meta = meta
+        self.data = data
+
+
+def _count_raw(direction: str, nbytes: int) -> None:
+    from ray_tpu.observability.rpc_metrics import RAW_BYTES, RAW_FRAMES
+
+    RAW_FRAMES.inc(labels={"direction": direction})
+    RAW_BYTES.inc(nbytes, labels={"direction": direction})
+
+
+def _encode_raw_header(seq: int, method: bytes, nbytes: int, meta=None) -> bytes:
+    """RAW frame header body (payload travels out-of-band after it)."""
+    return msgpack.packb([RAW, seq, method, nbytes, meta], use_bin_type=True)
+
+
+async def _read_raw_into(reader: asyncio.StreamReader, view, length: int) -> None:
+    """Receive ``length`` out-of-band payload bytes into ``view`` (a
+    writable buffer of at least ``length`` bytes). Copies land directly
+    in the destination; transient allocations are bounded by the
+    reader's buffer granularity, never the payload size."""
+    off = 0
+    while off < length:
+        chunk = await reader.read(min(length - off, _RAW_READ_CHUNK))
+        if not chunk:
+            raise asyncio.IncompleteReadError(b"", length - off)
+        view[off : off + len(chunk)] = chunk
+        off += len(chunk)
+
+
+async def _read_raw_bytes(reader: asyncio.StreamReader, length: int) -> bytearray:
+    """No-sink fallback: materialize the payload in one bytearray."""
+    buf = bytearray(length)
+    await _read_raw_into(reader, memoryview(buf), length)
+    return buf
+
+
+async def _read_raw_join(reader: asyncio.StreamReader, length: int) -> bytes:
+    """Materialize the payload as ``bytes`` with ONE full-size
+    allocation: join the reader's chunks directly (a bytearray +
+    ``bytes()`` round-trip would pay a second full-payload copy)."""
+    chunks: list = []
+    off = 0
+    while off < length:
+        chunk = await reader.read(min(length - off, _RAW_READ_CHUNK))
+        if not chunk:
+            raise asyncio.IncompleteReadError(b"", length - off)
+        chunks.append(chunk)
+        off += len(chunk)
+    return chunks[0] if len(chunks) == 1 else b"".join(chunks)
+
+
+async def _drain_raw(reader: asyncio.StreamReader, length: int) -> None:
+    """Discard an unwanted RAW payload, keeping the stream in sync."""
+    off = 0
+    while off < length:
+        chunk = await reader.read(min(length - off, _RAW_READ_CHUNK))
+        if not chunk:
+            raise asyncio.IncompleteReadError(b"", length - off)
+        off += len(chunk)
 
 
 async def _read_frame(reader: asyncio.StreamReader):
@@ -326,7 +499,10 @@ class RpcServer:
         self._handlers[method.encode()] = handler
 
     async def start(self) -> int:
-        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._on_conn, self.host, self.port,
+            limit=GLOBAL_CONFIG.rpc_stream_buffer_bytes,
+        )
         self.port = self._server.sockets[0].getsockname()[1]
         # handler timing registry (reference event_stats.h): every dispatch
         # below records queueing + run latency under the method name
@@ -336,12 +512,18 @@ class RpcServer:
         return self.port
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        _tune_transport(writer)
         conn = ServerConnection(reader, writer)
         self._conns.add(conn)
         try:
             while True:
                 try:
                     msg = await _read_frame(reader)
+                    if msg[0] == RAW:
+                        # clients don't send RAW requests today; drain the
+                        # out-of-band payload so the stream stays in sync
+                        await _drain_raw(reader, msg[3])
+                        continue
                 except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
                     break
                 # a BATCH frame dispatches all its requests from this ONE
@@ -439,6 +621,7 @@ class RpcServer:
                 fut: asyncio.Future = asyncio.get_event_loop().create_future()
                 self._dedup_inflight[dedup_key] = fut
             # --- execute ----------------------------------------------
+            raw_result: Optional[RawPayload] = None
             try:
                 try:
                     arg = pickle.loads(payload) if payload else None
@@ -452,14 +635,37 @@ class RpcServer:
                             result = await handler(arg, conn)
                     else:
                         result = await handler(arg, conn)
-                    record = (REPLY_OK, pickle.dumps(result, protocol=5))
+                    if isinstance(result, RawPayload):
+                        # zero-copy bulk reply: travels as a RAW frame and
+                        # NEVER enters the dedup reply cache (one multi-MiB
+                        # chunk would evict the whole 32 MiB control-plane
+                        # window) — bulk methods are idempotent reads, so a
+                        # post-eviction retry safely re-executes
+                        raw_result = result
+                        record = (
+                            REPLY_ERR,
+                            pickle.dumps(
+                                RpcError(
+                                    f"raw reply for {method_name} is not "
+                                    "cacheable; retry the call"
+                                )
+                            ),
+                        )
+                    else:
+                        record = (REPLY_OK, pickle.dumps(result, protocol=5))
                 except Exception as e:  # noqa: BLE001 — reply with the error
                     # the handler RAN (or its arguments were undecodable):
                     # the error IS the outcome, and a retry must get the
                     # same answer, not a second execution
                     record = (REPLY_ERR, pickle.dumps(e))
-                if dedup_key is not None:
+                if dedup_key is not None and raw_result is None:
                     self._dedup_record(dedup_key, record)
+                elif dedup_key is not None:
+                    # resolve duplicate waiters with the retryable error
+                    # WITHOUT caching (raw replies are dedup-exempt)
+                    fut = self._dedup_inflight.pop(dedup_key, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(record)
             finally:
                 # a cancelled execution (server stopping) must not leave
                 # duplicate waiters parked on a future nobody resolves
@@ -471,10 +677,15 @@ class RpcServer:
                 # the handler executed and its reply is cached — the lost
                 # reply is exactly the duplicate-execution trap; the
                 # client's retry must come back through the dedup path
+                if raw_result is not None:
+                    raw_result.release()
                 raise ChaosInjectedError(
                     f"chaos: reply dropped for {method_name} after execution"
                 )
-            await conn.send(record[0], seq, method, record[1])
+            if raw_result is not None:
+                await conn.send_raw(seq, method, raw_result)
+            else:
+                await conn.send(record[0], seq, method, record[1])
         except Exception as e:  # noqa: BLE001 — reply with the error
             try:
                 await conn.send(REPLY_ERR, seq, method, pickle.dumps(e))
@@ -546,8 +757,36 @@ class ServerConnection:
         if self._closed:
             raise ConnectionLost("connection closed")
         body = _encode_body(kind, seq, method, payload)
-        self._out.append(body)
-        self._out_bytes = getattr(self, "_out_bytes", 0) + len(body)
+        self._enqueue(body, len(body))
+        await self.writer.drain()
+
+    async def send_raw(self, seq: int, method: bytes, raw: RawPayload) -> None:
+        """Queue a RAW frame: header body + out-of-band payload, written
+        back to back at flush time (scatter-gather — the payload goes to
+        the transport straight from its source buffer, no concatenation
+        copy). ``raw.release()`` runs once the transport has consumed
+        the buffer."""
+        if self._closed:
+            raw.release()
+            raise ConnectionLost("connection closed")
+        nbytes = len(raw.payload)
+        header = _encode_raw_header(seq, method, nbytes, raw.meta)
+        self._enqueue((header, raw), len(header) + nbytes)
+        _count_raw("sent", nbytes)
+        await self.writer.drain()
+
+    async def push_raw(self, channel: int, envelope: Dict[str, Any], payload) -> None:
+        """Server-initiated RAW push: the bulk ``payload`` travels out of
+        band; the receiver reassembles ``envelope["data"] = payload`` and
+        hands the dict to the channel's push handler — same handler
+        contract as a plain :meth:`push`, minus the bulk pickle/msgpack
+        copies (the streaming-generator item transport)."""
+        meta = pickle.dumps(envelope, protocol=5)
+        await self.send_raw(channel, b"", RawPayload(payload, meta=meta))
+
+    def _enqueue(self, entry, nbytes: int) -> None:
+        self._out.append(entry)
+        self._out_bytes = getattr(self, "_out_bytes", 0) + nbytes
         if self._out_bytes >= _FLUSH_BYTES:
             # large buffers flush NOW: the cork trades one loop tick of
             # latency for syscall coalescing, but drain()'s flow control
@@ -556,23 +795,75 @@ class ServerConnection:
         elif not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_event_loop().call_soon(self._flush)
-        await self.writer.drain()
 
     def _flush(self) -> None:
         self._flush_scheduled = False
         if not self._out or self._closed:
-            self._out.clear()
+            self._drop_buffered()
             return
         bodies, self._out = self._out, []
         self._out_bytes = 0
         try:
-            # queued frames coalesce into batch frames: the peer gets one
-            # read wakeup for the whole flush (micro-batching)
-            self.writer.write(_wire_from_bodies(bodies))
+            # consecutive plain frames coalesce into batch frames (one
+            # peer read wakeup per flush); RAW entries break the run and
+            # write header + payload back to back — FIFO order holds
+            # across both kinds
+            run: list = []
+            for entry in bodies:
+                if isinstance(entry, bytes):
+                    run.append(entry)
+                    continue
+                if run:
+                    self.writer.write(_wire_from_bodies(run))
+                    run = []
+                header, raw = entry
+                self.writer.write(_LEN.pack(len(header)) + header)
+                try:
+                    if len(raw.payload):
+                        # straight from the source buffer: the transport
+                        # either sends now or keeps the unsent tail
+                        self.writer.write(raw.payload)
+                finally:
+                    self._release_when_flushed(raw)
+            if run:
+                self.writer.write(_wire_from_bodies(run))
         except Exception:
             # mark closed so subsequent sends fail fast instead of
             # buffering into a dead socket until the reader notices
             self._closed = True
+            for entry in bodies:  # release() is idempotent
+                if not isinstance(entry, bytes):
+                    entry[1].release()
+            self._drop_buffered()
+
+    def _release_when_flushed(self, raw: RawPayload) -> None:
+        """Release a RAW payload's source buffer once the transport can
+        no longer reference it. CPython < 3.12 selector transports COPY
+        any unsent tail into their own buffer, so releasing right after
+        ``write`` is safe; 3.12+ implements zero-copy writes (the
+        transport queues the ORIGINAL buffer object), so defer the
+        release until the write buffer has fully drained — releasing a
+        queued memoryview would fatally abort the connection mid-send."""
+        if sys.version_info < (3, 12) or self._closed:
+            raw.release()
+            return
+        try:
+            pending = self.writer.transport.get_write_buffer_size()
+        except Exception:
+            pending = 0
+        if pending == 0:
+            raw.release()
+            return
+        asyncio.get_event_loop().call_later(
+            0.02, self._release_when_flushed, raw
+        )
+
+    def _drop_buffered(self) -> None:
+        for entry in self._out:
+            if not isinstance(entry, bytes):
+                entry[1].release()
+        self._out = []
+        self._out_bytes = 0
 
     async def push(self, channel: int, payload: Any) -> None:
         """Server-initiated message on a subscription channel."""
@@ -583,8 +874,7 @@ class ServerConnection:
         output and kill the transport without a FIN handshake, so the
         peer sees a mid-call reset."""
         self._closed = True
-        self._out = []
-        self._out_bytes = 0
+        self._drop_buffered()
         try:
             self.writer.transport.abort()
         except Exception:
@@ -639,6 +929,10 @@ class RpcClient:
         self._seq = 0
         self._rid = 0
         self._pending: Dict[int, asyncio.Future] = {}
+        #: seq -> caller-provided writable buffer for RAW replies
+        #: (``call(raw_into=...)``); reset with ``_pending`` per
+        #: connection, entries popped when the reply arrives
+        self._raw_sinks: Dict[int, Any] = {}
         self._push_handlers: Dict[int, Callable[[Any], None]] = {}
         self._conn_lock: Optional[asyncio.Lock] = None
         #: monotonic stamp of the last FAILED connect attempt: callers
@@ -687,7 +981,11 @@ class RpcClient:
             delay = GLOBAL_CONFIG.rpc_retry_base_delay_s
             while True:
                 try:
-                    self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+                    self._reader, self._writer = await asyncio.open_connection(
+                        self.host, self.port,
+                        limit=GLOBAL_CONFIG.rpc_stream_buffer_bytes,
+                    )
+                    _tune_transport(self._writer)
                     break
                 except OSError:
                     if time.monotonic() > deadline or self._closed:
@@ -700,8 +998,11 @@ class RpcClient:
             # Fresh pending map per connection: a stale read loop's cleanup
             # must never fail calls issued on a newer connection.
             self._pending = {}
+            self._raw_sinks = {}
             self._read_task = asyncio.ensure_future(
-                self._read_loop(self._reader, self._writer, self._pending)
+                self._read_loop(
+                    self._reader, self._writer, self._pending, self._raw_sinks
+                )
             )
             reconnected = self._ever_connected
             self._ever_connected = True
@@ -720,10 +1021,15 @@ class RpcClient:
                 "on_reconnect hook for %s failed", self.name, exc_info=True
             )
 
-    async def _read_loop(self, reader, writer, pending):
+    async def _read_loop(self, reader, writer, pending, raw_sinks):
         try:
             while True:
                 msg = await _read_frame(reader)
+                if msg[0] == RAW:
+                    # out-of-band payload follows the header on the
+                    # stream: consume it before the next frame
+                    await self._handle_raw(reader, msg, pending, raw_sinks)
+                    continue
                 for m in _iter_messages(msg):
                     kind, seq, method, payload = m[0], m[1], m[2], m[3]
                     if kind == PUSH:
@@ -735,6 +1041,7 @@ class RpcClient:
                                 logger.exception("push handler failed")
                         continue
                     fut = pending.pop(seq, None)
+                    raw_sinks.pop(seq, None)
                     if fut is None or fut.done():
                         continue
                     if kind == REPLY_OK:
@@ -748,12 +1055,58 @@ class RpcClient:
                 if not fut.done():
                     fut.set_exception(ConnectionLost(f"connection to {self.name} lost"))
             pending.clear()
+            raw_sinks.clear()
             try:
                 writer.close()
             except Exception:
                 pass
             if self._writer is writer:
                 self._writer = None
+
+    async def _handle_raw(self, reader, msg, pending, raw_sinks) -> None:
+        """One RAW frame: a reply (non-empty method, seq matches a
+        pending call — received straight into the caller's sink when one
+        was registered) or a push (empty method, seq is the channel —
+        the pickled envelope in meta gets ``data`` reassembled)."""
+        _kind, seq, method, length, meta = (
+            msg[0], msg[1], msg[2], msg[3],
+            msg[4] if len(msg) > 4 else None,
+        )
+        if length > MAX_FRAME:
+            raise RpcError(f"raw payload too large: {length}")
+        if not method:
+            # RAW push: envelope dict + out-of-band bulk data
+            data = await _read_raw_join(reader, length)
+            _count_raw("received", length)
+            handler = self._push_handlers.get(seq)
+            if handler is not None:
+                try:
+                    envelope = pickle.loads(meta) if meta else {}
+                    envelope["data"] = data
+                    handler(envelope)
+                except Exception:
+                    logger.exception("raw push handler failed")
+            return
+        fut = pending.pop(seq, None)
+        sink = raw_sinks.pop(seq, None)
+        if fut is None or fut.done():
+            # late reply (caller timed out / retried): NEVER touch the
+            # caller's buffer — a retry may be rewriting the same range
+            await _drain_raw(reader, length)
+            return
+        if sink is not None and length <= len(sink):
+            await _read_raw_into(reader, sink, length)
+            _count_raw("received", length)
+            if not fut.done():
+                fut.set_result(RawReply(length, meta))
+            return
+        # no sink (plain call answered raw) or an undersized one:
+        # materialize — the caller still gets the payload, minus the
+        # zero-copy property
+        data = await _read_raw_bytes(reader, length)
+        _count_raw("received", length)
+        if not fut.done():
+            fut.set_result(RawReply(length, meta, data))
 
     def subscribe_push(self, channel: int, handler: Callable[[Any], None]) -> None:
         self._push_handlers[channel] = handler
@@ -768,8 +1121,15 @@ class RpcClient:
         connect_timeout: Optional[float] = None,
         request_id: Optional[int] = None,
         dedup: Optional[bool] = None,
+        raw_into=None,
     ):
         """One logical RPC with retry-until-done semantics.
+
+        * ``raw_into``: a writable buffer (memoryview) for a RAW reply —
+          the server's out-of-band payload is received straight into it
+          and the call resolves to a :class:`RawReply` (``data is None``
+          when the sink was used). A server answering with a plain reply
+          resolves normally; callers handle both shapes.
 
         * ``retries``: transport-failure retry budget; None = this
           client's ``default_retries``. ``timeout`` bounds each attempt.
@@ -807,7 +1167,8 @@ class RpcClient:
         while True:
             try:
                 return await self._call_once(
-                    method, payload, timeout, connect_timeout, rid if dedup else None
+                    method, payload, timeout, connect_timeout,
+                    rid if dedup else None, raw_into,
                 )
             except ChaosInjectedError as e:
                 chaos_attempts += 1
@@ -843,12 +1204,15 @@ class RpcClient:
         timeout: Optional[float],
         connect_timeout: Optional[float] = None,
         request_id: Optional[int] = None,
+        raw_into=None,
     ):
         await self._ensure_connected(connect_timeout)
         self._seq += 1
         seq = self._seq
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[seq] = fut
+        if raw_into is not None:
+            self._raw_sinks[seq] = raw_into
         try:
             # meta = [client_id, request_id, trace_ctx?]: request_id 0 is
             # the trace-only sentinel (no dedup); untraced calls without
@@ -878,6 +1242,7 @@ class RpcClient:
             await self._writer.drain()
         except (ConnectionResetError, BrokenPipeError, AttributeError) as e:
             self._pending.pop(seq, None)
+            self._raw_sinks.pop(seq, None)
             raise ConnectionLost(str(e))
         if timeout is None:
             return await fut
@@ -902,6 +1267,7 @@ class RpcClient:
                 if not fut.done():
                     fut.set_exception(ConnectionLost(f"write to {self.name} failed"))
             self._pending.clear()
+            self._raw_sinks.clear()
             try:
                 writer.close()
             except Exception:

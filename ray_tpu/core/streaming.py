@@ -17,6 +17,15 @@ number, an interrupted stream is re-dispatched to a survivor with
 so the client-visible sequence has no gaps and no repeats
 (``serve/router.py``).
 
+Transport: items push back over the submission connection. Inline item
+bytes at or above ``rpc_raw_stream_min_bytes`` ride RAW frames
+(``core/rpc.py`` kind 5) — the bulk payload travels out-of-band and the
+owner's push handler receives the reassembled envelope, skipping the
+pickle+msgpack copies of the item bytes on both ends; larger items go
+to shm and only their location travels, so their bytes ride the
+zero-copy RAW chunk-transfer path when a consumer on another node
+fetches them.
+
 Producer-side backpressure (the reference's consumer-position protocol):
 the generator pauses once ``produced - consumed`` reaches
 ``streaming_generator_backpressure_items``; the owner's throttled

@@ -133,14 +133,28 @@ class TaskExecutor:
         actor-task paths."""
         if spec.num_returns != "streaming" or conn is None:
             return None
+        from ray_tpu.core.config import GLOBAL_CONFIG
         from ray_tpu.core.streaming import STREAM_PUSH_CHANNEL
 
         loop_ = asyncio.get_event_loop()
 
         def emit(payload):  # runs on the lane thread
-            asyncio.run_coroutine_threadsafe(
-                conn.push(STREAM_PUSH_CHANNEL, payload), loop_
-            ).result(timeout=60)
+            # inline items past the threshold ride a RAW push: the item
+            # bytes travel out-of-band (zero pickle/msgpack of the bulk
+            # on either end); the receiver reassembles envelope["data"]
+            # and the owner-side handler is shape-identical
+            raw_min = GLOBAL_CONFIG.rpc_raw_stream_min_bytes
+            data = payload.get("data")
+            if (
+                raw_min >= 0
+                and data is not None
+                and len(data) >= raw_min
+            ):
+                envelope = {k: v for k, v in payload.items() if k != "data"}
+                coro = conn.push_raw(STREAM_PUSH_CHANNEL, envelope, data)
+            else:
+                coro = conn.push(STREAM_PUSH_CHANNEL, payload)
+            asyncio.run_coroutine_threadsafe(coro, loop_).result(timeout=60)
 
         return emit
 

@@ -18,6 +18,15 @@ Memory: each reducer materializes one output block (~dataset/N), the
 store holds the partition working set and spills under pressure — the
 driver's footprint stays O(refs). Determinism: fixing ``seed`` fixes
 the permutation for a given block structure.
+
+Wire: the all-to-all is refs-only at this layer; the partition BYTES
+move when each reducer's arg-fetch pulls its slices through the
+daemon↔daemon chunk transfer, which since the zero-copy data plane PR
+rides RAW frames end to end — sender segments scatter-gather onto the
+socket, receivers land chunks straight in the destination segment
+(``core/rpc.py`` kind 5, ``core/pull_manager.py``). ``bench.py``'s
+``shuffle_gbps`` phase measures this exchange across a 2-node cluster;
+``raytpu_shuffle_*`` counters surface exchange activity on /metrics.
 """
 
 from __future__ import annotations
@@ -28,6 +37,19 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.data.block import Block, block_concat, block_num_rows, block_take
+from ray_tpu.observability.metrics import Counter
+
+#: exchanges orchestrated by this driver process
+SHUFFLE_EXCHANGES = Counter(
+    "raytpu_shuffle_exchanges_total",
+    "shuffle exchanges orchestrated (driver-side)",
+)
+#: map-side partitions produced across all exchanges (n_in × n_out per
+#: exchange) — each is one ref a reducer fetches over the RAW data plane
+SHUFFLE_PARTITIONS = Counter(
+    "raytpu_shuffle_partitions_total",
+    "map partitions produced by shuffle exchanges (each fetched by a reducer)",
+)
 
 
 def _shuffle_map(block: Block, n_out: int, seed: int):
@@ -72,6 +94,8 @@ def shuffle_exchange(
     if not block_refs:
         return []
     n_out = num_output_blocks or len(block_refs)
+    SHUFFLE_EXCHANGES.inc()
+    SHUFFLE_PARTITIONS.inc(len(block_refs) * n_out)
     base = seed if seed is not None else np.random.SeedSequence().entropy % (2**31)
     mapper, reducer = _remotes()
     map_outs = [

@@ -36,6 +36,20 @@ RPC_CHAOS_INJECTIONS = Counter(
     ("mode",),
 )
 
+#: RAW frames moved (direction: sent|received) — the zero-copy bulk
+#: framing (core/rpc.py kind 5): chunk replies and stream-item pushes
+#: whose payload travelled out-of-band instead of through pickle/msgpack
+RAW_FRAMES = Counter(
+    "raytpu_raw_frames_total",
+    "RAW (zero-copy out-of-band payload) frames, by direction",
+    ("direction",),
+)
+RAW_BYTES = Counter(
+    "raytpu_raw_bytes_total",
+    "bytes carried out-of-band by RAW frames, by direction",
+    ("direction",),
+)
+
 #: controller reconnect/re-register events (role: daemon|driver|worker)
 CONTROLLER_RECONNECTS = Counter(
     "raytpu_controller_reconnects_total",
@@ -54,6 +68,15 @@ CONTROLLER_RECONNECTS = Counter(
 PULL_CHUNKS = Counter(
     "raytpu_pull_chunks_total",
     "object-transfer chunks fetched and verified by the pull manager",
+)
+
+#: chunks received ZERO-COPY: the RAW reply landed straight in the
+#: destination segment's unsealed window (vs the legacy copy fallback
+#: when a source answered with a pickled reply) — the copy-count guard
+#: in tests/test_perf_smoke.py pins PULL_RAW_CHUNKS == PULL_CHUNKS
+PULL_RAW_CHUNKS = Counter(
+    "raytpu_pull_raw_chunks_total",
+    "object-transfer chunks received zero-copy into the destination segment",
 )
 
 #: chunk attempts retried, by reason (timeout | transport | integrity |

@@ -82,7 +82,10 @@ class FakeSource:
     served (every later fetch aborts too — the source is "dead");
     ``chunk_delay_s`` paces chunks so tests can observe in-flight state;
     ``lose_objects_after`` makes fetch_chunk raise KeyError after N
-    chunks (the source evicted the object mid-transfer)."""
+    chunks (the source evicted the object mid-transfer). RAW-capable by
+    default (the receiver stamps ``raw: True`` and gets an out-of-band
+    payload, like a real daemon); ``no_raw`` forces the legacy pickled
+    tuple reply, ``no_chunk_crc`` the pre-crc raw-bytes shape."""
 
     def __init__(
         self,
@@ -93,6 +96,7 @@ class FakeSource:
         chunk_delay_s=0.0,
         lose_objects_after=None,
         no_chunk_crc=False,
+        no_raw=False,
     ):
         self.io = io
         self.objects = dict(objects)
@@ -100,6 +104,7 @@ class FakeSource:
         self.chunk_delay_s = chunk_delay_s
         self.lose_objects_after = lose_objects_after
         self.no_chunk_crc = no_chunk_crc
+        self.no_raw = no_raw
         self.info_calls = 0
         self.served_chunks = 0
 
@@ -134,8 +139,17 @@ class FakeSource:
         ):
             raise KeyError("object evicted")
         data = self.objects[payload["object_id"]]
-        chunk = data[payload["offset"] : payload["offset"] + payload["length"]]
         self.served_chunks += 1
+        if payload.get("raw") and not self.no_raw and not self.no_chunk_crc:
+            # zero-copy send: a memoryview straight out of the source
+            # object, like a real daemon's segment window
+            from ray_tpu.core.rpc import RawPayload
+
+            view = memoryview(data)[
+                payload["offset"] : payload["offset"] + payload["length"]
+            ]
+            return RawPayload(view, meta=zlib.crc32(view))
+        chunk = data[payload["offset"] : payload["offset"] + payload["length"]]
         if self.no_chunk_crc:
             return chunk  # legacy sender shape (raw bytes)
         return (chunk, zlib.crc32(chunk))
@@ -556,6 +570,17 @@ class StoreSource:
 
             async def fetch_chunk(payload, conn):
                 o = ObjectID(payload["object_id"])
+                if payload.get("raw"):
+                    from ray_tpu.core.rpc import RawPayload
+
+                    win = self.store.read_window(
+                        o, payload["offset"], payload["length"]
+                    )
+                    if win is None:
+                        raise KeyError("not here")
+                    return RawPayload(
+                        win.view, meta=zlib.crc32(win.view), close=win.close
+                    )
                 data = self.store.read_range(o, payload["offset"], payload["length"])
                 if data is None:
                     raise KeyError("not here")
@@ -656,18 +681,17 @@ def test_e2e_source_node_killed_mid_transfer():
         time.sleep(1.0)
         ray_tpu.init(address=cluster.address)
 
-        # num_cpus=0: the root cause of this test's load-flakiness was a
-        # SCHEDULING DEADLOCK, not transfer timing — workers do not
-        # release their CPU while blocked (the reference frees a
-        # blocked worker's resources during get/arg-fetch; see README
-        # "Known gaps"), so after the kill every CPU could be held by
-        # consume tasks parked in arg-fetch awaiting reconstruction,
-        # while the reconstructed produce tasks needed a CPU to run:
-        # whether the run completed was a lease-ordering race. Making
-        # produce CPU-free decouples it from the blocked consumers, so
-        # recovery is deadlock-free BY CONSTRUCTION — without touching
-        # the transfer-failover + lineage machinery under test.
-        @ray_tpu.remote(max_retries=5, num_cpus=0, resources={"src": 1})
+        # num_cpus=1 (the PR 10 workaround made this 0): the root cause
+        # of this test's load-flakiness was a SCHEDULING DEADLOCK —
+        # after the kill every CPU could be held by consume tasks parked
+        # in arg-fetch awaiting reconstruction while the reconstructed
+        # produce tasks needed a CPU to run. Blocked workers now RELEASE
+        # their CPU share during sync get/arg-fetch and re-acquire on
+        # wake (d_worker_blocked/d_worker_unblocked), so CPU-consuming
+        # producers compete fairly with their blocked consumers — this
+        # test is the regression gate for that release under real node
+        # death + lineage reconstruction.
+        @ray_tpu.remote(max_retries=5, num_cpus=1, resources={"src": 1})
         def produce(i):
             # STAGGERED durations (0.3s..3s): a flat sleep lets the whole
             # wave finish together, so any completion-based kill trigger

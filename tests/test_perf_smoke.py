@@ -175,3 +175,98 @@ def test_warm_prefix_ttft_and_hit_rate_smoke():
         assert eng.runner.recompiles_after_warmup() == 0
     finally:
         eng.stop()
+
+
+def test_chunk_receive_path_zero_copy_guard():
+    """Copy-count guard for the zero-copy data plane (cluster-free): pull
+    a multi-chunk object through the RAW path and assert (a) EVERY chunk
+    rode the zero-copy receive — raytpu_pull_raw_chunks_total advances by
+    exactly the chunk count, so a silent fallback to the pickled copy
+    path fails loudly — and (b) the tracemalloc'd python-allocator peak
+    during the transfer stays a small fraction of the payload: the
+    destination is an mmap-backed shm window (invisible to the traced
+    allocator) and the source serves memoryview windows, so any
+    full-payload bytes materialization creeping back into either end
+    (pickle of bulk, msgpack re-copy, whole-object heap buffer) trips
+    the bound."""
+    import tracemalloc
+    import zlib
+
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.core.ids import JobID, ObjectID, TaskID
+    from ray_tpu.core.object_store import ShmStore
+    from ray_tpu.core.pull_manager import PullManager
+    from ray_tpu.core.rpc import IoThread, RawPayload, RpcClient, RpcServer
+    from ray_tpu.observability.rpc_metrics import PULL_CHUNKS, PULL_RAW_CHUNKS
+
+    payload_mb = 16
+    chunk_bytes = 1024 * 1024
+    payload = bytes(bytearray(range(256)) * (payload_mb * 4096))
+    n_chunks = payload_mb  # 16 × 1 MiB
+    oid = ObjectID.for_put(TaskID.for_driver(JobID.from_index(9)), 777)
+
+    io = IoThread("copyguard-io")
+    old_chunk = GLOBAL_CONFIG.object_transfer_chunk_bytes
+    GLOBAL_CONFIG.object_transfer_chunk_bytes = chunk_bytes
+    store = ShmStore(capacity_bytes=4 * payload_mb * 1024 * 1024)
+    clients = {}
+
+    def peer(host, port):
+        key = (host, port)
+        if key not in clients:
+            clients[key] = RpcClient(host, port, name="copyguard", role="noded")
+        return clients[key]
+
+    async def setup():
+        server = RpcServer()
+
+        async def object_info(p, conn):
+            return {"size": len(payload), "digest": zlib.crc32(payload)}
+
+        async def fetch_chunk(p, conn):
+            view = memoryview(payload)[p["offset"] : p["offset"] + p["length"]]
+            assert p.get("raw"), "receiver stopped requesting RAW framing"
+            return RawPayload(view, meta=zlib.crc32(view))
+
+        server.register("object_info", object_info)
+        server.register("fetch_chunk", fetch_chunk)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    pm = PullManager(store, peer)
+    try:
+        raw_before = sum(PULL_RAW_CHUNKS._values.values())  # noqa: SLF001
+        total_before = sum(PULL_CHUNKS._values.values())  # noqa: SLF001
+        tracemalloc.start()
+        try:
+            reply = io.run(pm.pull(oid, [("127.0.0.1", port)]), timeout=120)
+            _current, peak = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        assert reply.get("segment"), reply
+        assert store.read_bytes(oid) == payload  # byte-exact, digest-sealed
+        raw_chunks = sum(PULL_RAW_CHUNKS._values.values()) - raw_before  # noqa: SLF001
+        chunks = sum(PULL_CHUNKS._values.values()) - total_before  # noqa: SLF001
+        assert chunks == n_chunks, (chunks, n_chunks)
+        assert raw_chunks == n_chunks, (
+            f"only {raw_chunks}/{n_chunks} chunks rode the zero-copy path"
+        )
+        # generous ceiling (×4 headroom over the observed ~1-2 MiB of
+        # transient reader/transport buffers) yet far below the 16 MiB
+        # payload: ONE full-payload bytes object would trip it
+        assert peak < payload_mb * 1024 * 1024 // 2, (
+            f"traced peak {peak / 1e6:.1f} MB — a full-payload copy is back "
+            "in the chunk receive path"
+        )
+    finally:
+        GLOBAL_CONFIG.object_transfer_chunk_bytes = old_chunk
+
+        async def teardown():
+            for c in clients.values():
+                await c.close()
+            await server.stop()
+
+        io.run(teardown())
+        store.shutdown()
+        io.stop()

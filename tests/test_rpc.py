@@ -598,3 +598,178 @@ def test_idempotent_methods_namespaced_per_role(io):
         io.run(noded.close())
     assert sum(counts.values()) > 8, counts  # at least one re-execution
     io.run(server.stop())
+
+
+# ---------------------------------------------------------------------------
+# RAW frames (kind 5): zero-copy out-of-band payload framing
+
+
+def _raw_server(io):
+    """Server whose ``blob`` handler answers with a RAW frame sliced out
+    of a source buffer (a stand-in for a shm segment window); ``crc``
+    rides the frame header. ``closes`` counts release-hook invocations."""
+    import zlib
+
+    from ray_tpu.core.rpc import RawPayload, RpcServer
+
+    src = bytes(range(256)) * 4096  # 1 MiB, patterned
+    closes = []
+
+    async def setup():
+        server = RpcServer()
+
+        async def blob(payload, ctx):
+            off, ln = payload["offset"], payload["length"]
+            view = memoryview(src)[off : off + ln]
+            return RawPayload(
+                view, meta=zlib.crc32(view), close=lambda: closes.append(1)
+            )
+
+        server.register("blob", blob)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    return server, port, src, closes
+
+
+def test_raw_reply_into_caller_buffer(io):
+    """A RAW reply lands DIRECTLY in the caller-provided buffer; the
+    header meta (crc) rides along; the sender's close hook runs."""
+    import zlib
+
+    from ray_tpu.core.rpc import RawReply, RpcClient
+
+    server, port, src, closes = _raw_server(io)
+    client = RpcClient("127.0.0.1", port)
+    sink = bytearray(64 * 1024)
+    reply = io.run(
+        client.call(
+            "blob", {"offset": 512, "length": 64 * 1024},
+            raw_into=memoryview(sink),
+        )
+    )
+    assert isinstance(reply, RawReply)
+    assert reply.nbytes == 64 * 1024 and reply.data is None
+    assert bytes(sink) == src[512 : 512 + 64 * 1024]
+    assert reply.meta == zlib.crc32(sink)
+    assert closes, "sender close hook never ran"
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_raw_reply_zero_length_and_oversized(io):
+    """Edge cases: a zero-length RAW payload resolves cleanly; a payload
+    larger than the sink falls back to materialized data (the stream
+    stays in sync either way — a following call still works)."""
+    from ray_tpu.core.rpc import RawReply, RpcClient
+
+    server, port, src, _closes = _raw_server(io)
+    client = RpcClient("127.0.0.1", port)
+    # zero-length
+    sink = bytearray(16)
+    reply = io.run(
+        client.call("blob", {"offset": 0, "length": 0}, raw_into=memoryview(sink))
+    )
+    assert isinstance(reply, RawReply) and reply.nbytes == 0 and reply.data is None
+    # oversized for the sink: materialized fallback, bytes still exact
+    small = bytearray(1024)
+    reply = io.run(
+        client.call(
+            "blob", {"offset": 0, "length": 8 * 1024},
+            raw_into=memoryview(small),
+        )
+    )
+    assert isinstance(reply, RawReply) and reply.nbytes == 8 * 1024
+    assert bytes(reply.data) == src[: 8 * 1024]
+    # stream still framed correctly afterwards
+    sink2 = bytearray(4096)
+    reply = io.run(
+        client.call("blob", {"offset": 4096, "length": 4096}, raw_into=memoryview(sink2))
+    )
+    assert bytes(sink2) == src[4096:8192]
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_raw_reply_without_sink_materializes(io):
+    """A plain call answered with a RAW frame still gets the payload —
+    as RawReply.data (the no-sink fallback), byte-exact."""
+    from ray_tpu.core.rpc import RawReply, RpcClient
+
+    server, port, src, _closes = _raw_server(io)
+    client = RpcClient("127.0.0.1", port)
+    reply = io.run(client.call("blob", {"offset": 100, "length": 3000}))
+    assert isinstance(reply, RawReply)
+    assert bytes(reply.data) == src[100:3100]
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_raw_replies_never_enter_dedup_cache(io):
+    """THE cache-churn guard: a dedup-stamped request answered RAW must
+    not put megabytes into the bounded reply cache — the cache stays
+    empty and duplicate retries re-execute (the raw methods are
+    idempotent reads by classification)."""
+    from ray_tpu.core.rpc import RpcClient
+
+    server, port, _src, _closes = _raw_server(io)
+    client = RpcClient("127.0.0.1", port)
+    sink = bytearray(4096)
+    # force a dedup stamp onto the raw call (real raw methods are
+    # classified idempotent and never stamp; this is the worst case)
+    rid = client.next_request_id()
+    reply = io.run(
+        client.call(
+            "blob", {"offset": 0, "length": 4096},
+            raw_into=memoryview(sink), request_id=rid, dedup=True,
+        )
+    )
+    assert reply.nbytes == 4096
+    assert len(server._dedup_done) == 0  # noqa: SLF001 — the assertion
+    assert server._dedup_bytes == 0  # noqa: SLF001
+    io.run(client.close())
+    io.run(server.stop())
+
+
+def test_raw_push_reassembles_envelope(io):
+    """RAW pushes (streaming-item transport): the pickled envelope rides
+    the frame header, the bulk payload out-of-band, and the subscriber's
+    handler receives the reassembled dict — same contract as push()."""
+    import threading
+
+    from ray_tpu.core.rpc import RpcClient, RpcServer
+
+    got = []
+    ev = threading.Event()
+    payload_bytes = bytes(range(256)) * 200  # 50 KiB
+
+    async def setup():
+        server = RpcServer()
+
+        async def kick(payload, ctx):
+            await ctx.push_raw(
+                9, {"task_id": b"t1", "index": 3, "kind": "inline"},
+                payload_bytes,
+            )
+            return "ok"
+
+        server.register("kick", kick)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    client = RpcClient("127.0.0.1", port)
+
+    def on_push(msg):
+        got.append(msg)
+        ev.set()
+
+    client.subscribe_push(9, on_push)
+    assert io.run(client.call("kick")) == "ok"
+    assert ev.wait(10)
+    (msg,) = got
+    assert msg["task_id"] == b"t1" and msg["index"] == 3
+    assert msg["data"] == payload_bytes
+    io.run(client.close())
+    io.run(server.stop())

@@ -98,3 +98,78 @@ def test_oom_killer_picks_newest_leased_worker():
         assert ray_tpu.get(refs, timeout=120) == [0, 1]
     finally:
         ray_tpu.shutdown()
+
+
+def test_blocked_worker_releases_cpu_for_nested_task():
+    """The classic nested-task deadlock (README "Known gaps", now fixed):
+    on a 1-CPU cluster a parent task that blocks in ray.get on a child
+    that ALSO needs 1 CPU can only complete if the blocked parent's CPU
+    is lent out for the duration — the reference frees a blocked
+    worker's resources during sync get/arg-fetch and re-acquires on
+    wake. Without the release this parks forever."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1)
+    try:
+
+        @ray_tpu.remote(num_cpus=1)
+        def child():
+            return 7
+
+        @ray_tpu.remote(num_cpus=1)
+        def parent():
+            return ray_tpu.get(child.remote(), timeout=90) + 1
+
+        assert ray_tpu.get(parent.remote(), timeout=120) == 8
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_blocked_release_accounting_balances():
+    """Daemon-side accounting property: block releases the CPU share to
+    the pool, unblock re-acquires; a lease released while the debt is
+    outstanding withholds exactly the released amount — available never
+    exceeds total and never leaks."""
+    import asyncio
+
+    from ray_tpu.core.node_daemon import Lease, NodeDaemon, WorkerProc
+    from ray_tpu.core.resources import NodeResources, ResourceSet
+
+    class FakeProc:
+        def poll(self):
+            return None
+
+    d = NodeDaemon.__new__(NodeDaemon)  # policy-only instance
+    d.resources = NodeResources(ResourceSet({"CPU": 2.0}))
+    d.workers = {}
+    d.leases = {}
+    d._bundle_pools = {}
+    d._capacity_event = asyncio.Event()
+    w = WorkerProc(1, FakeProc(), "tok-a")
+    d.workers["tok-a"] = w
+    d.resources.allocate(ResourceSet({"CPU": 2.0}))
+    d.leases[1] = Lease(1, {"CPU": 2.0}, w)
+
+    async def run():
+        assert d.resources.available.get("CPU") == 0.0
+        # block: the lease's CPUs go back to the pool
+        assert await d.d_worker_blocked({"token": "tok-a"}, None) is True
+        assert d.resources.available.get("CPU") == 2.0
+        # idempotent while already blocked
+        assert await d.d_worker_blocked({"token": "tok-a"}, None) is False
+        # another task takes 1.5 CPUs meanwhile
+        d.resources.allocate(ResourceSet({"CPU": 1.5}))
+        # wake: 2.0 don't fit (only 0.5 free) -> stays lent (oversubscribed)
+        assert await d.d_worker_unblocked({"token": "tok-a"}, None) is False
+        # lease release withholds the lent CPUs: available must end at
+        # exactly total - other task's 1.5, with no double release
+        d._release_lease(1)
+        assert d.resources.available.get("CPU") == 0.5
+        assert w.blocked_released is None
+        # the other task finishes: pool returns to full, not beyond
+        d.resources.release(ResourceSet({"CPU": 1.5}))
+        assert d.resources.available.get("CPU") == 2.0
+        # unknown workers / not-blocked workers are no-ops
+        assert await d.d_worker_unblocked({"token": "tok-a"}, None) is False
+        assert await d.d_worker_blocked({"token": "nope"}, None) is False
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(run())
