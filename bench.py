@@ -857,6 +857,121 @@ def bench_tpu(results: Dict[str, Dict]) -> None:
         print(f"  {k}: {results[k]}", file=sys.stderr, flush=True)
 
 
+def bench_ingress(results: Dict[str, Dict]) -> None:
+    """HTTP/SSE front door (serve/ingress.py): client-observed TTFT
+    through the FULL stack (urllib → aiohttp ingress → token bucket +
+    shed policy → router → streaming replica → engine), and goodput
+    under an overload mix — one abusive tenant hammering a tight bucket
+    while well-behaved tenants stream. Goodput counts only tokens
+    DELIVERED to admitted requests; the shed fraction is reported
+    alongside (shed requests cost the engines nothing — that is the
+    contract the number demonstrates)."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.inference.engine import EngineConfig
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.ingress import (
+        IngressConfig, IngressShedError, TenantPolicy, http_stream,
+        pick_ingress,
+    )
+
+    ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 4)))
+    try:
+        ec = EngineConfig(
+            num_blocks=64, block_size=8, prefill_buckets=(8, 16, 32),
+            decode_buckets=(1, 2, 4, 8), max_decode_batch=8,
+        )
+        serve.run(serve.llm_deployment(LlamaConfig.tiny(), engine=ec).bind())
+        ing_cfg = IngressConfig(
+            target="llm",
+            tenants={"abuser": TenantPolicy(
+                rate=20.0, burst=60.0, tenant_class="batch")},
+        )
+        serve.run(
+            serve.ingress_deployment("llm", ing_cfg, name="ingress").bind(),
+            name="ingress",
+        )
+        addrs = serve.ingress_addresses("ingress")
+        # warmup: route + stream path hot
+        list(http_stream(addrs[0], {"prompt": [1, 2, 3], "max_new_tokens": 4}))
+
+        n, new_tokens = 8, 32
+        ttfts: list = []
+        counts: list = []
+        sheds = [0]
+        lock = threading.Lock()
+
+        def consume(i: int) -> None:
+            tenant = f"tenant-{i}"
+            addr = pick_ingress(tenant, addrs)
+            t0 = time.perf_counter()
+            first, c = None, 0
+            try:
+                for _tok in http_stream(
+                    addr,
+                    {"prompt": [1 + i, 2, 3, 4 + i],
+                     "max_new_tokens": new_tokens},
+                    tenant=tenant, connect_timeout=300.0,
+                ):
+                    if first is None:
+                        first = time.perf_counter() - t0
+                    c += 1
+            except IngressShedError:
+                # a well-behaved stream shed under the abuser's pressure
+                # still counts as a (zero-token) sample — silently
+                # dropping it would inflate the reported goodput
+                with lock:
+                    sheds[0] += 1
+            with lock:
+                if first is not None:
+                    ttfts.append(first)
+                counts.append(c)
+
+        def abuse() -> None:
+            addr = pick_ingress("abuser", addrs)
+            for _ in range(20):
+                try:
+                    list(http_stream(
+                        addr, {"prompt": [9, 9, 9], "max_new_tokens": 8},
+                        tenant="abuser", connect_timeout=300.0,
+                    ))
+                except IngressShedError:
+                    with lock:
+                        sheds[0] += 1
+
+        start = time.perf_counter()
+        threads = [
+            threading.Thread(target=consume, args=(i,)) for i in range(n)
+        ] + [threading.Thread(target=abuse)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        if ttfts:
+            p50, p99 = _percentiles(ttfts, (0.50, 0.99))
+            results["serve_http_ttft_p50_p99"] = {
+                "value": round(p50 * 1000, 1), "p99": round(p99 * 1000, 1),
+                "unit": f"ms (HTTP SSE through the ingress tier, {n} streams)",
+            }
+        results["ingress_goodput"] = {
+            "value": round(sum(counts) / wall, 2),
+            "shed": sheds[0],
+            "unit": (
+                f"delivered tokens/s ({n} well-behaved streams + 1 abusive "
+                "tenant; shed = abuser 429s, zero engine slots consumed)"
+            ),
+        }
+        for k in ("serve_http_ttft_p50_p99", "ingress_goodput"):
+            if k in results:
+                print(f"  {k}: {results[k]}", file=sys.stderr, flush=True)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
 def main() -> None:
     results: Dict[str, Dict] = {}
     # Context: baselines were measured on a 64-vCPU m5.16xlarge; record this
@@ -880,6 +995,12 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         results["serve_llm_error"] = {"error": repr(e)}
         print(f"serve llm bench failed: {e!r}", file=sys.stderr, flush=True)
+    print("== HTTP ingress benchmarks ==", file=sys.stderr, flush=True)
+    try:
+        _phase_trace("ingress", lambda: bench_ingress(results))
+    except Exception as e:  # noqa: BLE001
+        results["ingress_error"] = {"error": repr(e)}
+        print(f"ingress bench failed: {e!r}", file=sys.stderr, flush=True)
     print("== TPU compute benchmarks ==", file=sys.stderr, flush=True)
     try:
         _phase_trace("tpu", lambda: bench_tpu(results))
@@ -921,6 +1042,8 @@ def main() -> None:
         ("serve_llm_scale_1rep_tokens_per_s", "serve_llm_scale_1rep_tokens_per_s"),
         ("serve_llm_2rep_tokens_per_s", "serve_llm_2rep_tokens_per_s"),
         ("serve_llm_resume_ttft_p50", "serve_llm_resume_ttft_p50_ms"),
+        ("serve_http_ttft_p50_p99", "serve_http_ttft_p50_ms"),
+        ("ingress_goodput", "ingress_goodput_tokens_per_s"),
     ):
         v = results.get(key, {})
         if v.get("value") is not None:

@@ -227,6 +227,22 @@ class GlobalConfig:
     #: loop still replies. <= 0 disables the poll.
     serve_replica_health_period_s: float = 1.0
 
+    # --- serve ingress (serve/ingress.py: the HTTP/SSE front door) ---
+    #: per-request deadline when the client sends none (header
+    #: x-request-timeout-s / body timeout_s override, clamped to this as
+    #: a ceiling) — stamped into the ambient core/deadline budget so the
+    #: engine stops decoding for callers that gave up
+    serve_ingress_default_timeout_s: float = 60.0
+    #: Retry-After hint (seconds) on pressure sheds; rate-limit sheds
+    #: compute the exact bucket-refill wait instead
+    serve_ingress_retry_after_s: float = 1.0
+    #: default per-tenant token-bucket refill rate, in COST units/s
+    #: (cost of one request = prompt tokens + max_new_tokens); tenants
+    #: without an explicit TenantPolicy get this
+    serve_ingress_default_rate: float = 4000.0
+    #: default per-tenant bucket capacity (burst allowance), cost units
+    serve_ingress_default_burst: float = 8000.0
+
     # --- runtime_env ---
     #: TTL on the driver-side working_dir/py_modules change-signature
     #: cache: within this window a .remote() carrying a runtime_env

@@ -169,15 +169,28 @@ class ObjectRefGenerator:
         self._backend.release_hold([oid])
         return ref
 
-    def __del__(self):
-        # Abandoned before exhaustion: release the owner-side holds on
-        # items never handed out, or they pin memory forever.
+    def abandon(self) -> None:
+        """Explicitly release this stream: drop the owner-side holds on
+        items never handed out and cancel a still-running producer (the
+        owner forwards a cooperative ``cancel_task`` to the executing
+        worker, which closes the producing generator — an engine request
+        behind it gets ``cancel()``ed and frees its KV blocks). Idempotent
+        and safe after exhaustion (a finished stream has nothing running
+        to cancel). Called by consumers that stop reading mid-stream —
+        the serve router's stream wrappers call it on ``close()`` so an
+        HTTP client disconnect propagates all the way down — and by
+        ``__del__`` as the GC backstop."""
         try:
             abandon = getattr(self._backend, "abandon_stream", None)
             if abandon is not None:
                 abandon(self._task_id, self._pos)
         except Exception:
             pass
+
+    def __del__(self):
+        # Abandoned before exhaustion: release the owner-side holds on
+        # items never handed out, or they pin memory forever.
+        self.abandon()
 
     def __repr__(self) -> str:
         return f"ObjectRefGenerator({self._task_id.hex()[:16]}, pos={self._pos})"

@@ -635,6 +635,7 @@ class TaskExecutor:
             with self._stream_lock:
                 self._stream_events[tid] = threading.Event()
         count = 0
+        result = None
         try:
             result = fn(*args, **kwargs)
             if inspect.isasyncgen(result):
@@ -648,6 +649,19 @@ class TaskExecutor:
                 )
             for value in result:
                 count += 1
+                # cooperative cancel consulted on EVERY item, not only in
+                # the backpressure wait below: an abandoned stream (the
+                # consumer's ObjectRefGenerator was dropped/closed — e.g.
+                # an HTTP client disconnected mid-SSE) must stop the
+                # producer within one item, not after it outruns the
+                # consumer by a full backpressure window. The finally
+                # close()s the generator, so a producer built on
+                # engine.generate() runs its cancel() cleanup and frees
+                # its KV blocks promptly.
+                with self._cancel_lock:
+                    if tid in self._cancelled:
+                        self._cancelled.discard(tid)
+                        raise TaskCancelledError(spec.task_id.hex()[:16])
                 # producer-side backpressure: pause while the consumer
                 # lags by more than the threshold; the owner's consumed
                 # reports (w_stream_consumed) resume us. Cancellation is
@@ -680,10 +694,25 @@ class TaskExecutor:
                         "data" if kind == "inline" else "location": payload,
                     }
                 )
+        except TaskCancelledError as e:
+            # surfaced as the cancellation itself (the owner usually
+            # abandoned the stream and isn't reading), not an app failure
+            return [streaming_error_result(e)]
         except Exception as e:  # noqa: BLE001
             err = e if isinstance(e, TaskError) else TaskError(spec.name, e)
             return [streaming_error_result(err)]
         finally:
+            # Close the producer DETERMINISTICALLY (not on GC): a cancel/
+            # error exit leaves the generator suspended at its last yield,
+            # and its finally blocks (engine.generate -> engine.cancel,
+            # replica ongoing-count decrement) must run before this task
+            # slot is reported free.
+            close = getattr(result, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — cleanup must not mask
+                    pass
             with self._stream_lock:
                 self._stream_consumed.pop(tid, None)
                 self._stream_events.pop(tid, None)

@@ -856,6 +856,12 @@ class InferenceEngine:
             "block_size": self.blocks.block_size,
             "prefix_digest": self.blocks.prefix_digest(),
             "draining": self._draining,
+            # queue-pressure export for the ingress tier: the admission
+            # BOUND (so a proxy can judge fullness, not just depth) and
+            # the monotonic intake count (so shed-vs-admitted reconciles
+            # without a replica round-trip per request)
+            "max_queue_depth": self.engine_cfg.max_queue_depth,
+            "total_admitted": self.scheduler.total_admitted,
         }
 
     def healthy(self) -> bool:

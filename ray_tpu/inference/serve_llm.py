@@ -174,6 +174,14 @@ class LLMServer:
         """Non-streaming: returns the full generation in one reply."""
         return {"tokens": list(self.generate(request))}
 
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a queued/running request by id; frees its KV blocks.
+        The serve stream-close path usually beats callers to it (an
+        abandoned stream cancels its producer task, which closes the
+        generator and cancels the engine request) — this is the explicit
+        escape hatch for callers that tracked only the request id."""
+        return self.engine.cancel(str(request_id))
+
     # -- introspection ----------------------------------------------------
     def engine_stats(self) -> Dict[str, Any]:
         return self.engine.stats()

@@ -199,3 +199,37 @@ SERVE_REPLICA_RESTARTS = Counter(
     "serve replicas killed for replacement, by reason (death|unhealthy)",
     ("reason",),
 )
+
+# -- HTTP/SSE ingress (serve/ingress.py) ------------------------------------
+# The front door's overload behavior must be first-class telemetry: how
+# much traffic each tenant class brought and what happened to it, how
+# much was shed BEFORE consuming an engine queue slot (and why), and the
+# client-observed time-to-first-byte SLO distribution. Counters live in
+# the ingress replica processes (each exports /metrics like any serve
+# replica host process would).
+
+#: terminal outcome per request: ok | shed | bad_request | error |
+#: disconnect (client went away mid-stream — its engine work was
+#: cancelled, not completed)
+INGRESS_REQUESTS = Counter(
+    "raytpu_ingress_requests_total",
+    "HTTP ingress requests, by tenant class and terminal outcome",
+    ("tenant_class", "outcome"),
+)
+
+#: requests refused with 429 BEFORE any downstream dispatch, by reason:
+#: rate_limit (tenant token bucket dry), load (gossiped outstanding
+#: tokens above the class watermark), queue_pressure (engine admission
+#: queues filling — only the top class may still queue)
+INGRESS_SHED = Counter(
+    "raytpu_ingress_shed_total",
+    "ingress requests shed before reaching an engine, by reason",
+    ("reason",),
+)
+
+#: request arrival to first streamed byte (SSE) / full reply (JSON) —
+#: the client-observed TTFT envelope the shed policy protects
+INGRESS_TTFB = Histogram(
+    "raytpu_ingress_ttfb_seconds",
+    "ingress request arrival to first response byte",
+)

@@ -33,6 +33,14 @@ from ray_tpu.serve.controller import (
     CONTROLLER_NAME,
     get_or_create_controller,
 )
+from ray_tpu.serve.ingress import (
+    HttpIngress,
+    IngressConfig,
+    TenantPolicy,
+    ingress_addresses,
+    ingress_deployment,
+    pick_ingress,
+)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.proxy import start_http, stop_http
 from ray_tpu.serve.router import Router
@@ -236,6 +244,12 @@ __all__ = [
     "deployment",
     "get_deployment_handle",
     "get_multiplexed_model_id",
+    "HttpIngress",
+    "IngressConfig",
+    "TenantPolicy",
+    "ingress_addresses",
+    "ingress_deployment",
+    "pick_ingress",
     # llm_deployment/LLMServer stay OUT of __all__: star-imports resolve
     # every listed name, which would trigger the lazy __getattr__ above
     # and drag jax into plain serve users. Reach them by attribute.

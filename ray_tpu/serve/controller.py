@@ -356,6 +356,34 @@ class _ServeController:
                 out[prefix] = name
             return out
 
+    @staticmethod
+    def _pressure_of(st: _DeploymentState) -> Dict[str, Any]:
+        """Shed/queue pressure rollup from FRESH replica gossip — what
+        lets an operator see shedding and engine backlog straight from
+        ``serve.status()`` without scraping /metrics. ``queue_depth`` /
+        ``outstanding_tokens`` come from engine replicas
+        (``InferenceEngine.routing_stats``); ``shed_total`` from ingress
+        replicas (``serve/ingress.py`` gossips its shed counter the same
+        way). Stale reports (older than ``serve_routing_stats_ttl_s``)
+        are excluded: a wedged replica's last gossip must not pin
+        phantom pressure into the status view."""
+        now = time.monotonic()
+        ttl = GLOBAL_CONFIG.serve_routing_stats_ttl_s
+        queue_depth = 0
+        outstanding = 0.0
+        shed = 0
+        for stats, received in st.replica_stats.values():
+            if now - received > ttl:
+                continue
+            queue_depth += int(stats.get("queue_depth") or 0)
+            outstanding += float(stats.get("outstanding_tokens") or 0.0)
+            shed += int(stats.get("shed_total") or 0)
+        return {
+            "queue_depth": queue_depth,
+            "outstanding_tokens": round(outstanding, 1),
+            "shed_total": shed,
+        }
+
     def status(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
             return {
@@ -372,6 +400,7 @@ class _ServeController:
                     ),
                     "autoscaling": st.config.autoscaling is not None,
                     "restarts": dict(st.restarts),
+                    **self._pressure_of(st),
                 }
                 for name, st in self._deployments.items()
             }

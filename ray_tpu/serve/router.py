@@ -269,20 +269,34 @@ class Router:
         if len(replicas) == 1:
             _count_decision(self._deployment, "single")
             return replicas[0]
-        chosen = self._choose_scored(replicas, request_args)
+        chosen, fallback = self._choose_scored(replicas, request_args)
         if chosen is not None:
             return chosen
         a, b = random.sample(replicas, 2)
         qa, qb = self._queue_len(a), self._queue_len(b)
-        _count_decision(self._deployment, "pow2")
+        # a gossip-capable deployment falling back on STALE signals is a
+        # DIFFERENT condition than a plain deployment (never had stats)
+        # or an all-draining window (fresh gossip, nothing routable):
+        # split it out so load tests can assert the scored path actually
+        # engaged — a run whose decisions are all stale_fallback means
+        # the gossip cadence (or TTL) is mistuned
+        with self._replicas_lock:
+            had_gossip = bool(self._rstats)
+        _count_decision(
+            self._deployment,
+            "stale_fallback" if (fallback == "stale" and had_gossip) else "pow2",
+        )
         return a if qa <= qb else b
 
     def _choose_scored(self, replicas, request_args):
         """Least-outstanding-tokens blended with prefix affinity, over
-        replica-gossiped stats. Returns None (→ pow-2 fallback) unless
-        EVERY candidate has gossip fresher than the staleness TTL — a
-        replica without fresh signals scored at an assumed load would
-        either starve (assumed busy) or drown (assumed idle)."""
+        replica-gossiped stats. Returns ``(choice, None)``, or
+        ``(None, reason)`` (→ pow-2 fallback) — ``reason`` is "stale"
+        unless EVERY candidate has gossip fresher than the staleness TTL
+        (a replica without fresh signals scored at an assumed load would
+        either starve or drown), or "draining" when the signals are
+        fresh but every candidate is draining (an attributably different
+        condition — fallback counters must not blame the gossip)."""
         now = time.monotonic()
         ttl = GLOBAL_CONFIG.serve_routing_stats_ttl_s
         entries = []
@@ -290,7 +304,7 @@ class Router:
             for r in replicas:
                 ent = self._rstats.get(r.actor_id)
                 if ent is None or now - ent[0] > ttl:
-                    return None  # absent/stale signal: fall back
+                    return None, "stale"  # absent/stale signal: fall back
                 entries.append((r, ent[1], ent[2]))
             bumps = dict(self._local_tokens)
         prompt = _request_prompt(request_args)
@@ -326,7 +340,7 @@ class Router:
             if best_key is None or key < best_key:
                 best, best_key, best_matched = r, key, matched_tokens
         if best is None:
-            return None  # every gossiping replica is draining
+            return None, "draining"  # every gossiping replica is draining
         # optimistic local debit: what this dispatch will add to the
         # winner's backlog before its next gossip lands
         est = 64.0
@@ -339,7 +353,46 @@ class Router:
         _count_decision(
             self._deployment, "affinity", affinity_hit=best_matched > 0
         )
-        return best
+        return best, None
+
+    def cluster_pressure(self) -> Dict[str, Any]:
+        """Aggregate gossiped engine pressure over the current routing
+        set — the ingress tier's shed signal (serve/ingress.py). Sums
+        FRESH reports only (``serve_routing_stats_ttl_s``); the local
+        optimistic bumps (requests this router dispatched since each
+        replica's last gossip) are folded into ``outstanding_tokens`` so
+        a burst inside one gossip period registers as pressure
+        immediately instead of after the next report lands.
+
+        Non-blocking by design: a shed decision must cost a dict scan,
+        never a controller round-trip — with no replicas (or no gossip)
+        yet, ``reporting`` is 0 and the caller decides (the ingress
+        admits: never shed blind)."""
+        self._ensure_poller()
+        now = time.monotonic()
+        ttl = GLOBAL_CONFIG.serve_routing_stats_ttl_s
+        with self._replicas_lock:
+            n = len(self._replicas)
+            entries = list(self._rstats.values())
+            local = sum(self._local_tokens.values())
+        queue_depth = 0
+        outstanding = 0.0
+        max_queue = 0
+        reporting = 0
+        for received, stats, _digest, _stamp in entries:
+            if now - received > ttl:
+                continue
+            reporting += 1
+            queue_depth += int(stats.get("queue_depth") or 0)
+            outstanding += float(stats.get("outstanding_tokens") or 0.0)
+            max_queue += int(stats.get("max_queue_depth") or 0)
+        return {
+            "replicas": n,
+            "reporting": reporting,
+            "queue_depth": queue_depth,
+            "outstanding_tokens": outstanding + local,
+            "max_queue_depth": max_queue,
+        }
 
     def _queue_len(self, replica) -> float:
         now = time.monotonic()
@@ -578,12 +631,19 @@ class Router:
                     last_err = e
                     self._drop_replica(replica)
                     continue
-                it = iter(gen)
-
-                def _rest(first=first, it=it):
-                    yield first
-                    for ref in it:
-                        yield ray_tpu.get(ref, timeout=item_timeout)
+                def _rest(first=first, gen=gen):
+                    try:
+                        yield first
+                        for ref in gen:
+                            yield ray_tpu.get(ref, timeout=item_timeout)
+                    finally:
+                        # consumer done OR walked away (close()/GC — an
+                        # HTTP client disconnect closes this generator):
+                        # release the ref stream and cooperatively cancel
+                        # a still-running producer so the replica's
+                        # engine request is cancelled and frees its KV
+                        # blocks instead of decoding for nobody
+                        gen.abandon()
 
                 return _rest()
         raise last_err or TimeoutError(
@@ -647,6 +707,7 @@ class Router:
                 deadline = Deadline.after(budget if budget is not None else 3600)
                 progress_before = gate.next_seq
                 replica = None
+                gen = None
                 try:
                     try:
                         replica = self.choose_replica(model_id, [attempt_req])
@@ -719,6 +780,14 @@ class Router:
                     attempt += 1
                     _count_stream_resume(self._deployment, len(delivered))
                     continue
+                finally:
+                    # every exit — normal end, failover to the next
+                    # attempt, consumer close (GeneratorExit lands at the
+                    # yield above) — releases this attempt's ref stream
+                    # and cancels a still-running producer, so a client
+                    # that disconnects mid-stream frees the engine slot
+                    if gen is not None:
+                        gen.abandon()
 
         # prime the first token eagerly (matching the non-resumable
         # path: dispatch problems raise at call time, not first next())

@@ -167,6 +167,18 @@ def _chaos_repro_line(nodeid: str):
         spec = getattr(cfg, spec_key)
         if spec and spec_key not in entries:
             entries[spec_key] = (spec, seed_key, getattr(cfg, seed_key))
+        # env-armed plans (the ingress/stream-resume E2E pattern: the
+        # test exports RAY_TPU_testing_* so CHILD processes inherit the
+        # plan while the driver's GLOBAL_CONFIG stays clean — env is
+        # only read at import). Without this probe exactly those
+        # failures printed no repro line.
+        env_spec = os.environ.get("RAY_TPU_" + spec_key)
+        if env_spec and spec_key not in entries:
+            entries[spec_key] = (
+                env_spec,
+                seed_key,
+                os.environ.get("RAY_TPU_" + seed_key) or 0,
+            )
     if not entries:
         return None
     parts = []

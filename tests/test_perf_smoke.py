@@ -177,6 +177,83 @@ def test_warm_prefix_ttft_and_hit_rate_smoke():
         eng.stop()
 
 
+def test_ingress_http_path_smoke():
+    """HTTP ingress floor (bench.py's serve_http_ttft_p50_p99 /
+    ingress_goodput phase, floored): 4 concurrent SSE streams through
+    the full stack — urllib → aiohttp ingress (bucket + shed policy) →
+    router → streaming replica → engine. Warm numbers on this box are
+    ~40-150 ms TTFT p50 and hundreds of delivered tokens/s; the floors
+    trip only an order-of-magnitude regression (a blocking call parked
+    on the ingress event loop, the shed path running per-token, the
+    stream detouring through a non-streaming path)."""
+    pytest.importorskip("jax")
+    import threading
+
+    from ray_tpu import serve
+    from ray_tpu.inference.engine import EngineConfig
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.ingress import IngressConfig, http_stream
+
+    ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 4)))
+    try:
+        ec = EngineConfig(
+            num_blocks=64, block_size=8, prefill_buckets=(8, 32),
+            decode_buckets=(1, 4), max_decode_batch=4,
+        )
+        serve.run(serve.llm_deployment(LlamaConfig.tiny(), engine=ec).bind())
+        serve.run(
+            serve.ingress_deployment(
+                "llm", IngressConfig(target="llm"), name="ingress"
+            ).bind(),
+            name="ingress",
+        )
+        addr = serve.ingress_addresses("ingress")[0]
+        list(http_stream(addr, {"prompt": [1, 2, 3], "max_new_tokens": 4}))
+
+        def one_round():
+            n, new_tokens = 4, 16
+            ttfts, counts = [], []
+            lock = threading.Lock()
+
+            def consume(i):
+                t0 = time.perf_counter()
+                first, c = None, 0
+                for _ in http_stream(
+                    addr,
+                    {"prompt": [1 + i, 2, 3], "max_new_tokens": new_tokens},
+                    tenant=f"t{i}", connect_timeout=120.0,
+                ):
+                    if first is None:
+                        first = time.perf_counter() - t0
+                    c += 1
+                with lock:
+                    ttfts.append(first)
+                    counts.append(c)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=consume, args=(i,)) for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            wall = time.perf_counter() - t0
+            assert sum(counts) == n * new_tokens, counts
+            return sorted(ttfts)[len(ttfts) // 2], sum(counts) / wall
+
+        ttft_p50, goodput = one_round()
+        if ttft_p50 > 2.0 or goodput < 20.0:
+            # load-aware re-judge (the _floored_rate shape): median-of-3
+            rounds = sorted([(ttft_p50, goodput), one_round(), one_round()])
+            ttft_p50, goodput = rounds[1]
+        assert ttft_p50 < 2.0, f"ingress TTFT p50 collapsed: {ttft_p50:.2f}s"
+        assert goodput >= 20.0, f"ingress goodput collapsed: {goodput:.0f} tok/s"
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
 def test_chunk_receive_path_zero_copy_guard():
     """Copy-count guard for the zero-copy data plane (cluster-free): pull
     a multi-chunk object through the RAW path and assert (a) EVERY chunk
