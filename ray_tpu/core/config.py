@@ -60,6 +60,14 @@ class GlobalConfig:
     #: virtualized hosts; verification and shm writes stay strictly
     #: sequential regardless. 1 disables pipelining.
     pull_pipeline_depth: int = 4
+    #: daemon-side receive-segment reuse pool cap (bytes): segments of
+    #: transfer-received objects deleted with ``recycle_receive`` (and
+    #: aborted receives this store created) are renamed into a warm
+    #: LRU pool instead of unlinked, and ``allocate_receive`` reuses a
+    #: fitting one — repeated KV migrations skip segment create/zero
+    #: (this 4.4-kernel sandbox can't MADV_POPULATE; warm inodes are
+    #: the substitute). 0 disables the pool.
+    receive_segment_pool_bytes: int = 128 * 1024**2
 
     # --- scheduling ---
     # Hybrid policy: prefer local node until it exceeds this utilization
@@ -227,6 +235,22 @@ class GlobalConfig:
     #: loop still replies. <= 0 disables the poll.
     serve_replica_health_period_s: float = 1.0
 
+    # --- disaggregated prefill/decode serving (inference/kv_transfer.py) ---
+    #: budget for the whole prefill-pool handoff (dispatch prefill_export
+    #: + KV publish) before the router degrades the request to plain
+    #: single-replica generation — the failure ladder's first rung
+    serve_disagg_handoff_timeout_s: float = 30.0
+    #: prompts whose FULL blocks span fewer tokens than this skip the
+    #: disagg handoff entirely (migrating a couple of blocks costs more
+    #: than re-prefilling them); also the router's guard when gossip
+    #: hasn't told it the engine block size yet
+    serve_disagg_min_prompt_tokens: int = 16
+    #: published KV exports nobody consumed are reaped after this long
+    kv_export_ttl_s: float = 120.0
+    #: descriptor-inline payload cap for daemon-less processes (local
+    #: mode / unit tests) — bigger exports fail → plain generation
+    kv_inline_max_bytes: int = 32 * 1024**2
+
     # --- serve ingress (serve/ingress.py: the HTTP/SSE front door) ---
     #: per-request deadline when the client sends none (header
     #: x-request-timeout-s / body timeout_s override, clamped to this as
@@ -242,6 +266,10 @@ class GlobalConfig:
     serve_ingress_default_rate: float = 4000.0
     #: default per-tenant bucket capacity (burst allowance), cost units
     serve_ingress_default_burst: float = 8000.0
+    #: how often an ingress replica snapshots per-tenant bucket fill
+    #: levels to the serve controller (restored by replacement replicas,
+    #: so a restart doesn't refill every tenant's budget). <= 0 disables.
+    serve_ingress_bucket_snapshot_period_s: float = 1.0
 
     # --- runtime_env ---
     #: TTL on the driver-side working_dir/py_modules change-signature

@@ -1379,6 +1379,10 @@ class NodeDaemon:
         return self.store.delete(
             ObjectID(payload["object_id"]),
             allow_recycle=bool(payload.get("allow_recycle")),
+            # KV-migration importers send this after releasing their
+            # mapping: the received segment's inode joins the store's
+            # receive reuse pool instead of being unlinked
+            recycle_receive=bool(payload.get("recycle_receive")),
         )
 
     def _peer(self, host: str, port: int) -> RpcClient:

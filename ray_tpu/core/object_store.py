@@ -194,6 +194,18 @@ class ShmStore:
         # death/shutdown), cached briefly
         self._pool_debt = 0
         self._pool_debt_ts = 0.0
+        # daemon-side receive-segment reuse pool (KV-migration satellite):
+        # transfer-received segments deleted with ``recycle_receive`` (and
+        # aborted receives this store created) keep their warm inode here
+        # — pool file name -> byte size, oldest first — and the next
+        # allocate_receive of a fitting size RENAMES one back instead of
+        # paying segment create + zero-fill (no MADV_POPULATE on this
+        # kernel; warm pages are the substitute)
+        self._recv_pool: "OrderedDict[str, int]" = OrderedDict()
+        self._recv_pool_bytes = 0
+        self._recv_pool_seq = 0
+        self.num_recv_pool_hits = 0
+        self.num_recv_pool_puts = 0
 
     # -- accounting ------------------------------------------------------
     @property
@@ -231,7 +243,74 @@ class ShmStore:
                 "num_spilled": self.num_spilled,
                 "num_restored": self.num_restored,
                 "num_evicted": self.num_evicted,
+                "recv_pool_bytes": self._recv_pool_bytes,
+                "recv_pool_segments": len(self._recv_pool),
+                "recv_pool_hits": self.num_recv_pool_hits,
+                "recv_pool_puts": self.num_recv_pool_puts,
             }
+
+    # -- receive-segment reuse pool --------------------------------------
+    def _pool_receive_segment_locked(self, object_id: ObjectID, size: int) -> bool:
+        """Move a private receive segment's inode into the reuse pool
+        instead of unlinking it. Caller must hold the lock and must have
+        already dropped the entry. Returns False (caller unlinks) when
+        pooling is off, full, or the rename fails."""
+        limit = GLOBAL_CONFIG.receive_segment_pool_bytes
+        if limit <= 0 or size <= 0:
+            return False
+        self._recv_pool_seq += 1
+        pool_name = f"rt-rpool-{os.getpid()}-{self._recv_pool_seq}"
+        try:
+            os.rename(
+                os.path.join(_SHM_DIR, segment_name(object_id)),
+                os.path.join(_SHM_DIR, pool_name),
+            )
+        except OSError:
+            return False
+        try:
+            # physical size, not the entry's logical size: a segment that
+            # was itself a pool hit can be larger than the object it held
+            size = os.path.getsize(os.path.join(_SHM_DIR, pool_name))
+        except OSError:
+            pass
+        self._recv_pool[pool_name] = size
+        self._recv_pool_bytes += size
+        self.num_recv_pool_puts += 1
+        while self._recv_pool_bytes > limit and self._recv_pool:
+            victim, vsize = self._recv_pool.popitem(last=False)
+            self._recv_pool_bytes -= vsize
+            try:
+                os.unlink(os.path.join(_SHM_DIR, victim))
+            except OSError:
+                pass
+        return True
+
+    def _take_recv_pooled_locked(self, object_id: ObjectID, size: int) -> bool:
+        """Claim a pooled receive segment that fits ``size`` without
+        gross waste (same tight-fit rule as the worker pool: slack is
+        invisible to accounting, bound it) and rename it to the object's
+        segment name. Never overwrites an existing inode — on simulated
+        shared-/dev/shm clusters the target name may BE the source's
+        live copy, and a rename-over would destroy it (the ``forget()``
+        hazard class); the plain create path handles that case."""
+        target = os.path.join(_SHM_DIR, segment_name(object_id))
+        if os.path.exists(target):
+            return False
+        for name, psize in self._recv_pool.items():
+            if psize >= size and psize <= size + max(size >> 3, 1 << 20):
+                del self._recv_pool[name]
+                self._recv_pool_bytes -= psize
+                try:
+                    os.rename(os.path.join(_SHM_DIR, name), target)
+                except OSError:
+                    try:
+                        os.unlink(os.path.join(_SHM_DIR, name))
+                    except OSError:
+                        pass
+                    return False
+                self.num_recv_pool_hits += 1
+                return True
+        return False
 
     # -- create/adopt ----------------------------------------------------
     def adopt(self, object_id: ObjectID, size: int) -> None:
@@ -290,15 +369,17 @@ class ShmStore:
         with self._lock:
             self._make_room(size)
             inode_owner = True
-            try:
-                seg = _create(segment_name(object_id), size)
-                seg.close()
-            except FileExistsError:
-                # simulated multi-node: the source shares this /dev/shm,
-                # the inode already holds the (immutable) content — write
-                # over it with identical bytes, but never unlink it on
-                # abort (the source still serves from it)
-                inode_owner = False
+            if not self._take_recv_pooled_locked(object_id, size):
+                try:
+                    seg = _create(segment_name(object_id), size)
+                    seg.close()
+                except FileExistsError:
+                    # simulated multi-node: the source shares this
+                    # /dev/shm, the inode already holds the (immutable)
+                    # content — write over it with identical bytes, but
+                    # never unlink it on abort (the source still serves
+                    # from it)
+                    inode_owner = False
             self._entries[object_id] = _Entry(
                 size=size, sealed=False, primary=False, inode_owner=inode_owner
             )
@@ -328,6 +409,12 @@ class ShmStore:
         self._entries.pop(object_id, None)
         self._used -= e.size
         if e.inode_owner:
+            # no reader ever saw an unsealed entry, so the inode is
+            # private: recycle it into the receive pool (a failed
+            # transfer's retry is exactly the repeat case the pool is
+            # for); unlink only when pooling declines it
+            if self._pool_receive_segment_locked(object_id, e.size):
+                return
             try:
                 seg = _attach(segment_name(object_id))
                 seg.unlink()
@@ -412,6 +499,19 @@ class ShmStore:
             )
         threshold = int(self.capacity * GLOBAL_CONFIG.object_spilling_threshold)
         debt = self._recycle_pool_debt()
+        # the receive pool holds real tmpfs pages too — drain it before
+        # spilling live objects (pool entries are pure cache)
+        while (
+            self._used + debt + self._recv_pool_bytes + size > threshold
+            and self._recv_pool
+        ):
+            victim, vsize = self._recv_pool.popitem(last=False)
+            self._recv_pool_bytes -= vsize
+            try:
+                os.unlink(os.path.join(_SHM_DIR, victim))
+            except OSError:
+                pass
+        debt += self._recv_pool_bytes
         while self._used + debt + size > threshold and self._spill_one():
             pass
         if self._used + debt + size > self.capacity:
@@ -533,13 +633,49 @@ class ShmStore:
             if e and e.pinned > 0:
                 e.pinned -= 1
 
-    def delete(self, object_id: ObjectID, allow_recycle: bool = False) -> bool:
+    def delete(
+        self,
+        object_id: ObjectID,
+        allow_recycle: bool = False,
+        recycle_receive: bool = False,
+    ) -> bool:
         """Drop an object. With ``allow_recycle`` (sent by the deleting
         OWNER, who created the segment and keeps it mapped), a segment no
         reader ever resolved is released *without unlinking*: the caller
         takes ownership of the inode for its reuse pool. Returns True in
-        exactly that case."""
+        exactly that case.
+
+        ``recycle_receive`` is the DAEMON-side analogue for
+        transfer-received objects (KV migration): the caller asserts it
+        was the object's only consumer and has released its mapping, so
+        the inode goes into this store's receive-segment reuse pool
+        instead of being unlinked. The store can't verify the assertion
+        — a caller that lies hands a still-mapped inode to a future
+        transfer, which would scribble over the liar's view — so only
+        transfer-private objects (like migration payloads) may use it.
+        Restricted to in-shm, unpinned, inode-owning entries."""
         with self._lock:
+            if recycle_receive:
+                e = self._entries.get(object_id)
+                if (
+                    e is not None
+                    and e.in_shm
+                    and e.pinned == 0
+                    and e.inode_owner
+                    and e.spilled_path is None
+                ):
+                    self._entries.pop(object_id)
+                    self._used -= e.size
+                    if self._pool_receive_segment_locked(object_id, e.size):
+                        return True
+                    # pooling declined: fall through to a plain unlink
+                    try:
+                        seg = _attach(segment_name(object_id))
+                        seg.unlink()
+                        seg.close()
+                    except FileNotFoundError:
+                        pass
+                    return False
             if allow_recycle:
                 e = self._entries.get(object_id)
                 if (
@@ -596,6 +732,13 @@ class ShmStore:
         with self._lock:
             for oid in list(self._entries):
                 self._drop(oid)
+            for name in self._recv_pool:
+                try:
+                    os.unlink(os.path.join(_SHM_DIR, name))
+                except OSError:
+                    pass
+            self._recv_pool.clear()
+            self._recv_pool_bytes = 0
 
 
 _SHM_DIR = "/dev/shm"

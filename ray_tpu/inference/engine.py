@@ -157,6 +157,12 @@ class EngineConfig:
     #: cached blocks are reclaimed LRU-first whenever allocation needs
     #: them, so the cache never starves admission)
     prefix_cache_max_blocks: int = 0
+    #: KV-cache migration (disaggregated prefill/decode serving): opts
+    #: this engine into the block gather/scatter programs — compiled at
+    #: warmup so migrations never recompile — and the export/import
+    #: request modes (prefill_kv / import_kv_blocks). Off by default so
+    #: plain deployments keep their exact compile count.
+    kv_transfer_enabled: bool = False
 
     def resolved_prefill_buckets(self, max_seq_len: int) -> Sequence[int]:
         if self.prefill_buckets is not None:
@@ -299,9 +305,14 @@ class InferenceEngine:
         self._token_times: deque = deque(maxlen=2048)
         self._preempt_seen = 0
         self._prefix_seen: Dict[str, int] = {}
+        #: queued KV-import jobs, executed BY the step thread at the top
+        #: of each step — device cache mutation must never race the step
+        #: loop's own cache swaps (donation on TPU invalidates the buffer
+        #: a concurrent reader grabbed)
+        self._kv_imports: "queue.Queue" = queue.Queue()
         self.total_steps = 0
         if ec.warmup:
-            self.runner.warmup()
+            self.runner.warmup(kv_io=ec.kv_transfer_enabled)
         else:
             self.runner.mark_warm()
 
@@ -324,6 +335,14 @@ class InferenceEngine:
         # another token — fail them so callers blocked in tokens() wake
         # instead of hanging on q.get() forever
         self._fail_all(RequestFailedError("engine stopped"))
+        # parked KV importers would likewise wait on a thread that will
+        # never run their job again
+        while True:
+            try:
+                _tokens, _kv, reply = self._kv_imports.get_nowait()
+            except queue.Empty:
+                break
+            reply.put((False, RequestFailedError("engine stopped")))
         self.detach_node_drain_listener()
 
     def _loop(self) -> None:
@@ -351,10 +370,13 @@ class InferenceEngine:
         request_id: Optional[str] = None,
         seed: Optional[int] = None,
         timeout_s: Optional[float] = None,
+        prefill_only: bool = False,
     ) -> str:
         """Enqueue a generation request; returns its id. The ambient
         ``core.deadline`` budget (or explicit ``timeout_s``, whichever is
-        tighter) bounds the request end to end."""
+        tighter) bounds the request end to end. ``prefill_only`` is the
+        KV-migration export mode (use :meth:`prefill_kv`, which also
+        drains the payload)."""
         if self._draining or not self.scheduler.admitting:
             raise EngineDrainingError("engine is draining: not admitting requests")
         prompt = [int(t) for t in prompt]
@@ -391,6 +413,7 @@ class InferenceEngine:
             eos_token=eos_token,
             deadline=Deadline.after(budget) if budget is not None else None,
             seed=seed,
+            prefill_only=prefill_only,
         )
         trace_wire = _tracing.current_wire()
         with self._lock:
@@ -552,6 +575,7 @@ class InferenceEngine:
             self._fail_all(
                 RequestFailedError("engine drain grace expired mid-generation")
             )
+        did_import = self._drain_kv_imports()
         plan = self.scheduler.schedule()
         for req in plan.reaped:
             self._finish_request(
@@ -562,7 +586,7 @@ class InferenceEngine:
                 ),
             )
         if not plan.prefills and not plan.decodes:
-            return not plan.empty
+            return did_import or not plan.empty
         self._consult_replica_chaos(plan)
 
         # timeline timestamps share the module's wall-clock epoch so
@@ -588,8 +612,14 @@ class InferenceEngine:
                 # the prompt's K/V is fully written: index its full
                 # blocks so later requests sharing the prefix skip them
                 self.blocks.register_prefix(req.request_id, prompt)
-                req.state = DECODE
-                self._emit_token(req, self._sample(req, logits))
+                if req.prefill_only:
+                    # KV-migration export: gather the full blocks to
+                    # host and hand the payload to the waiting exporter
+                    # — no token is ever sampled on this engine
+                    self._complete_prefill_export(req, prompt)
+                else:
+                    req.state = DECODE
+                    self._emit_token(req, self._sample(req, logits))
 
         if plan.decodes:
             toks = [r.generated[-1] for r in plan.decodes]
@@ -646,31 +676,189 @@ class InferenceEngine:
         phase's device work — a kill lands after the last emitted token
         and before the next one samples, the boundary the router's
         seq-numbered resume must cover."""
-        chaos = self.testing_fault_plan or active_replica_fault_plan()
-        if chaos is None:
-            return
         for phase, present in (
             ("prefill", bool(plan.prefills)),
             ("decode", bool(plan.decodes)),
         ):
-            if not present:
-                continue
-            fault = chaos.consult(phase)
-            if fault is None:
-                continue
-            mode, param = fault
-            if mode == "stall":
-                logger.warning(
-                    "replica chaos: stalling step loop %.2fs (seed=%d)",
-                    param, chaos.seed,
+            if present:
+                self._consult_phase_chaos(phase)
+
+    def _consult_phase_chaos(self, phase: str) -> None:
+        """One chaos consult for a named engine phase ("prefill" |
+        "decode" | "export" | "import" — the latter two are the
+        KV-migration consult points: a kill there lands exactly
+        mid-handoff, which the disagg fallback ladder must absorb)."""
+        chaos = self.testing_fault_plan or active_replica_fault_plan()
+        if chaos is None:
+            return
+        fault = chaos.consult(phase)
+        if fault is None:
+            return
+        mode, param = fault
+        if mode == "stall":
+            logger.warning(
+                "replica chaos: stalling step loop %.2fs (seed=%d)",
+                param, chaos.seed,
+            )
+            time.sleep(param)
+        else:
+            logger.warning(
+                "replica chaos: %s — SIGKILL self (pid=%d seed=%d)",
+                mode, os.getpid(), chaos.seed,
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- KV-cache migration (disaggregated serving) -----------------------
+    def prefill_kv(
+        self,
+        prompt: Sequence[int],
+        *,
+        priority: int = 0,
+        request_id: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Export mode: run ONLY the prompt's prefill, then gather its
+        FULL KV blocks to host and return the payload ``{"tokens":
+        covered_tokens, "kv": np[2, L, n, bs, n_kv, hd], "block_size"}``
+        — the migration unit ``inference/kv_transfer.py`` serializes and
+        ships. Returns None when the prompt spans no full block (nothing
+        exportable — the caller falls back to plain generation). The
+        prefill itself also populates THIS engine's radix index, so an
+        exporting replica keeps the warm-prefix benefit locally."""
+        rid = self.submit(
+            prompt,
+            max_new_tokens=1,
+            priority=priority,
+            request_id=request_id,
+            timeout_s=timeout_s,
+            prefill_only=True,
+        )
+        q = self._out.get(rid)
+        try:
+            while True:
+                item = q.get(timeout=timeout_s)
+                if item is _END:
+                    return None
+                if isinstance(item, Exception):
+                    raise item
+                if isinstance(item, tuple) and item and item[0] == "kv_export":
+                    return item[1]
+        except queue.Empty:
+            self.cancel(rid)
+            raise TimeoutError(
+                f"kv export of {len(prompt)} prompt tokens not done within "
+                f"{timeout_s}s"
+            ) from None
+        finally:
+            with self._lock:
+                self._out.pop(rid, None)
+                self._finished_at.pop(rid, None)
+
+    def _complete_prefill_export(self, req: Request, prompt) -> None:
+        """Step-thread half of :meth:`prefill_kv`: the gather MUST run
+        here — the step loop swaps (and on TPU donates) the cache value
+        every step, so a reader on another thread could hold an
+        invalidated buffer."""
+        self._consult_phase_chaos("export")
+        bs = self.blocks.block_size
+        n_full = len(prompt) // bs
+        try:
+            payload = None
+            if n_full > 0:
+                blocks = self.blocks.owned(req.request_id)[:n_full]
+                kv = self.runner.gather_blocks(blocks)
+                payload = {
+                    "tokens": list(prompt[: n_full * bs]),
+                    "kv": kv,
+                    "block_size": bs,
+                }
+        except Exception as e:  # noqa: BLE001 — exporter must not hang
+            if self.scheduler.finish(req, FAILED):
+                req.state = FAILED
+                self._finish_request(
+                    req, FAILED,
+                    error=RequestFailedError(f"kv export failed: {e!r}"),
                 )
-                time.sleep(param)
-            else:
-                logger.warning(
-                    "replica chaos: %s — SIGKILL self (pid=%d seed=%d)",
-                    mode, os.getpid(), chaos.seed,
-                )
-                os.kill(os.getpid(), signal.SIGKILL)
+            return
+        if payload is not None:
+            with self._lock:
+                q = self._out.get(req.request_id)
+            if q is not None:
+                q.put(("kv_export", payload))
+        if self.scheduler.finish(req, FINISHED):
+            self._finish_request(req, FINISHED, error=None)
+
+    def import_kv_blocks(
+        self, tokens: Sequence[int], kv, timeout_s: float = 30.0
+    ) -> int:
+        """Install migrated KV blocks into this engine's cache + radix
+        index (the import half of KV migration). ``kv`` is the
+        :meth:`prefill_kv` payload layout; block i must hold the K/V of
+        ``tokens[i*bs:(i+1)*bs]``. Queued to the STEP THREAD (cache
+        mutation must not race its swaps) and waited on here. Returns
+        the number of prompt tokens now covered by the radix index —
+        the immediately-following submit acquires them as a prefix hit.
+        Raises on block-pool exhaustion or scatter failure (callers
+        degrade to a plain prefill)."""
+        bs = self.blocks.block_size
+        n = min(len(tokens) // bs, int(kv.shape[2]))
+        if n <= 0:
+            return 0
+        reply: "queue.Queue" = queue.Queue()
+        self._kv_imports.put((list(tokens[: n * bs]), kv[:, :, :n], reply))
+        self._work.set()
+        try:
+            ok, result = reply.get(timeout=timeout_s)
+        except queue.Empty:
+            raise TimeoutError(
+                f"kv import of {n} blocks not executed within {timeout_s}s"
+            ) from None
+        if not ok:
+            raise result
+        return result
+
+    def _drain_kv_imports(self) -> bool:
+        """Step-thread executor for queued KV imports. Each job:
+        reserve pinned blocks → device scatter → commit into the radix
+        index (redundant blocks freed). All-or-nothing per job; failures
+        surface to the waiting importer, never wedge the step loop."""
+        did = False
+        while True:
+            try:
+                tokens, kv, reply = self._kv_imports.get_nowait()
+            except queue.Empty:
+                return did
+            did = True
+            try:
+                self._consult_phase_chaos("import")
+                bs = self.blocks.block_size
+                n = len(tokens) // bs
+                blocks = self.blocks.reserve_import(n)
+                if blocks is None:
+                    reply.put((
+                        False,
+                        RequestFailedError(
+                            f"kv import: pool cannot cover {n} blocks"
+                        ),
+                    ))
+                    continue
+                try:
+                    # no ascontiguousarray: scatter_blocks's per-chunk
+                    # packing copies handle non-contiguous views, and a
+                    # whole-payload memcpy here would stall the standing
+                    # decode batch — on the step thread, for the full
+                    # payload size, on every import
+                    self.runner.scatter_blocks(blocks, kv)
+                except Exception as e:  # noqa: BLE001
+                    self.blocks.abort_import(blocks)
+                    reply.put((False, e))
+                    continue
+                self.blocks.commit_import(blocks, tokens)
+                # covered tokens, not blocks indexed: duplicates of
+                # already-indexed prefixes still serve acquire_prefix
+                reply.put((True, n * bs))
+            except Exception as e:  # noqa: BLE001
+                reply.put((False, e))
 
     def _emit_token(self, req: Request, token: int) -> None:
         if req.finished:

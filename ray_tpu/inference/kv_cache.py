@@ -110,6 +110,12 @@ class PagedBlockManager:
         #: shared system prompt must stay inside that window no matter
         #: how long ago it was first indexed.
         self._index: "OrderedDict[bytes, int]" = OrderedDict()
+        #: chain digest -> PARENT chain digest (b"" at the root): the
+        #: radix-path structure the flat index erases. Gossip export
+        #: walks these so every exported digest ships with its whole
+        #: ancestor spine — a consecutive-prefix matcher (the router)
+        #: can't use an orphan digest whose ancestors were truncated out.
+        self._parent: Dict[bytes, bytes] = {}
         #: unreferenced cached blocks, oldest first (block -> digest)
         self._lru: "OrderedDict[int, bytes]" = OrderedDict()
         #: request -> COW source blocks pinned until the device copy ran
@@ -166,6 +172,7 @@ class PagedBlockManager:
             blk, digest = self._lru.popitem(last=False)
             del self._index[digest]
             del self._block_hash[blk]
+            self._parent.pop(digest, None)
             self.prefix_evictions_total += 1
             return blk
         return None
@@ -353,6 +360,7 @@ class PagedBlockManager:
             added = 0
             prev = b""
             for i in range(n_full):
+                parent = prev
                 prev = _chain_digest(prev, tokens[i * bs : (i + 1) * bs])
                 blk = blocks[i]
                 if blk in self._block_hash:
@@ -367,22 +375,126 @@ class PagedBlockManager:
                     old_blk, old_digest = self._lru.popitem(last=False)
                     del self._index[old_digest]
                     del self._block_hash[old_blk]
+                    self._parent.pop(old_digest, None)
                     self._free.append(old_blk)
                     self.prefix_evictions_total += 1
                 self._block_hash[blk] = prev
                 self._index[prev] = blk
+                self._parent[prev] = parent
                 added += 1
             return added
 
     def prefix_digest(self, max_entries: int = 256) -> List[int]:
-        """Compact cache summary for router gossip: the most recently
-        USED chain digests (hits refresh recency, so a hot shared
-        system prompt never ages out of the window), truncated to
-        64-bit ints (a router-side false positive just routes
-        suboptimally)."""
+        """Compact cache summary for router gossip: a bounded
+        RADIX-PATH export instead of the old flat recent-N slice.
+
+        The router's affinity scorer matches consecutively from block 0
+        and stops at the first miss, so an exported digest is only
+        usable when its entire ancestor chain is exported with it. The
+        flat MRU slice broke exactly that once the index outgrew the
+        budget: it kept the N most-recently-used blocks as arbitrary
+        points, truncating the ancestors a deep hot path needs. Here we
+        walk the index MRU-first and export whole root-anchored SPINES
+        (each digest plus every ancestor still indexed), skipping spines
+        that don't fit the remaining budget or whose chain is broken by
+        eviction (their descendants can never match anyway) — so with
+        >10k indexed blocks the gossip covers the hottest complete
+        paths, not a useless frontier of orphans.
+
+        Truncation contract (unchanged): entries are the first 8 bytes
+        of the 16-byte chain digest as signed 64-bit ints. A router-side
+        collision is a FALSE POSITIVE ONLY — it routes a request to a
+        replica that turns out cold, costing one suboptimal placement;
+        correctness never depends on this digest (the engine re-derives
+        full 16-byte digests at admission)."""
+        out: List[bytes] = []
         with self._lock:
-            digests = list(self._index.keys())[-max_entries:]
-        return [struct.unpack("<q", d[:8])[0] for d in digests]
+            seen = set()
+            for digest in reversed(self._index):
+                if len(out) >= max_entries:
+                    break
+                if digest in seen:
+                    continue  # already exported as an ancestor
+                spine: List[bytes] = []
+                d = digest
+                complete = True
+                while d:
+                    if d in seen:
+                        break  # ancestors already in the export
+                    if d not in self._index:
+                        complete = False  # evicted mid-chain: orphan path
+                        break
+                    spine.append(d)
+                    d = self._parent.get(d, b"")
+                if not complete or len(out) + len(spine) > max_entries:
+                    continue
+                seen.update(spine)
+                out.extend(spine)
+        return [struct.unpack("<q", d[:8])[0] for d in out]
+
+    # -- KV-cache migration (disaggregated serving) -----------------------
+    def reserve_import(self, num_blocks: int) -> Optional[List[int]]:
+        """Allocate blocks for migrated KV content, each pinned (ref=1)
+        until :meth:`commit_import` or :meth:`abort_import` — the device
+        scatter runs between reserve and commit, and an unpinned block
+        could be reclaimed out from under it. Returns None (nothing
+        taken) when the pool can't cover the import — the caller falls
+        back to a plain prefill instead of wedging admission."""
+        with self._lock:
+            if num_blocks <= 0:
+                return []
+            if num_blocks > len(self._free) + len(self._lru):
+                return None
+            out: List[int] = []
+            for _ in range(num_blocks):
+                blk = self._take_block_locked()
+                self._ref[blk] = 1
+                out.append(blk)
+            self.total_allocs += num_blocks
+            return out
+
+    def commit_import(self, blocks: List[int], tokens) -> int:
+        """Index scattered import blocks in the radix structure so later
+        admissions (the migrated request first of all) acquire them as
+        prefix hits. Block i must hold the K/V of
+        ``tokens[i*bs:(i+1)*bs]`` — the chain digest is recomputed here
+        from the tokens, never trusted from the wire. Blocks whose
+        prefix another local block already serves are redundant copies:
+        released straight back to the free list. Every committed block
+        drops its import pin and parks cached-unreferenced (LRU), i.e.
+        imported KV costs nothing until someone uses or evicts it.
+        Returns the number of blocks actually indexed."""
+        bs = self.block_size
+        n = min(len(blocks), len(tokens) // bs)
+        added = 0
+        with self._lock:
+            prev = b""
+            for i in range(n):
+                parent = prev
+                prev = _chain_digest(prev, tokens[i * bs : (i + 1) * bs])
+                blk = blocks[i]
+                if prev in self._index or blk in self._block_hash:
+                    # an equivalent block is already indexed locally:
+                    # drop the imported copy (no digest -> free list)
+                    self._release_block_locked(blk)
+                    continue
+                self._block_hash[blk] = prev
+                self._index[prev] = blk
+                self._parent[prev] = parent
+                # pin released WITH the digest set: lands on the LRU as
+                # a cached-unreferenced block
+                self._release_block_locked(blk)
+                added += 1
+            # surplus reserve (shouldn't happen: caller sizes exactly)
+            for blk in blocks[n:]:
+                self._release_block_locked(blk)
+        return added
+
+    def abort_import(self, blocks: List[int]) -> None:
+        """Scatter failed: return reserved (never-indexed) blocks."""
+        with self._lock:
+            for blk in blocks:
+                self._release_block_locked(blk)
 
     def prefix_stats(self) -> Dict[str, float]:
         with self._lock:

@@ -1,0 +1,390 @@
+"""KV-cache migration transport: replica→replica block shipping.
+
+The handoff unit of disaggregated prefill/decode serving
+(``serve_llm.llm_deployment(disaggregated=True)``): a prefill replica
+runs ``engine.prefill_kv`` (export-after-prefill), this module PUBLISHES
+the gathered block payload into the local daemon's shm store, and the
+decode replica FETCHES it by asking *its* daemon to ``pull_object`` from
+the source daemon — so migrated bytes ride the existing zero-copy data
+plane end to end: RAW chunk frames received straight into the
+destination segment, per-chunk CRC verification, whole-object digest
+before seal (PR 8 + PR 11), resumable multi-source failover, admission
+control. Nothing here re-implements transfer; the "object" is simply a
+set of paged KV blocks, exactly the Ray-paper move of coordinating
+specialized actors through the object plane.
+
+Integrity ladder (digest-before-attach): the pull path verifies each
+chunk CRC and the source-advertised whole-object digest before the
+segment seals; :func:`fetch` additionally compares the store digest
+against the CRC the *exporter* stamped into the descriptor — which also
+covers the same-node short-circuit where no transfer ran at all. Only
+then does the importing engine scatter the blocks into its cache.
+
+Descriptors are small picklable dicts (they travel router→replica in
+request payloads). When the process has no node daemon (local mode,
+unit tests), the payload is carried INLINE in the descriptor up to
+``kv_inline_max_bytes`` — same CRC gate, no data plane.
+
+Lifetime: published segments are owned by the source daemon's store and
+reaped after ``kv_export_ttl_s`` (the importer usually deletes its own
+received copy immediately, recycling the segment into the daemon's
+receive pool — ``ShmStore`` satellite). jax-free by design: routers and
+ingress processes import this for the fallback/handoff metrics.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+import uuid
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+
+logger = logging.getLogger(__name__)
+
+
+class KvTransferError(RuntimeError):
+    """A migration step failed (publish, pull, digest, import). Always
+    recoverable: the caller degrades to plain single-replica generation
+    under the existing resume machinery."""
+
+
+# -- metrics (registered once per process) ----------------------------------
+
+_METRICS = None
+_METRICS_LOCK = threading.Lock()
+
+
+def migration_metrics():
+    """``raytpu_kv_migration_*`` counters + the disagg handoff timer
+    (README Observability catalog)."""
+    global _METRICS
+    if _METRICS is None:
+        from ray_tpu.observability.metrics import Counter, Histogram
+
+        with _METRICS_LOCK:
+            if _METRICS is None:
+                _METRICS = {
+                    "transfers": Counter(
+                        "raytpu_kv_migration_transfers_total",
+                        "KV block payloads successfully migrated "
+                        "replica-to-replica",
+                    ),
+                    "bytes": Counter(
+                        "raytpu_kv_migration_bytes_total",
+                        "KV bytes carried by successful migrations",
+                    ),
+                    "failures": Counter(
+                        "raytpu_kv_migration_failures_total",
+                        "migration steps that failed, by stage",
+                        ("stage",),
+                    ),
+                    "fallbacks": Counter(
+                        "raytpu_kv_migration_fallbacks_total",
+                        "requests degraded to plain generation, by reason",
+                        ("reason",),
+                    ),
+                    "handoff": Histogram(
+                        "raytpu_disagg_handoff_seconds",
+                        "prefill-dispatch to KV-descriptor latency "
+                        "(disaggregated serving handoff)",
+                        buckets=(
+                            0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                            0.5, 1.0, 2.5, 5.0, 10.0,
+                        ),
+                    ),
+                }
+    return _METRICS
+
+
+def count_failure(stage: str) -> None:
+    migration_metrics()["failures"].inc(labels={"stage": stage})
+
+
+def count_fallback(reason: str) -> None:
+    migration_metrics()["fallbacks"].inc(labels={"reason": reason})
+
+
+# -- plumbing ---------------------------------------------------------------
+
+
+def _backend():
+    """The process's cluster backend, or None when there is no node
+    daemon to publish through (local mode → inline descriptors)."""
+    try:
+        from ray_tpu.core.api import _global_worker
+
+        w = _global_worker()
+        if w is None:
+            return None
+        be = w.backend
+        if getattr(be, "daemon", None) is None or getattr(be, "io", None) is None:
+            return None
+        return be
+    except Exception:  # noqa: BLE001 — absence of a backend is normal
+        return None
+
+
+def _kv_object_id(transfer_id: str):
+    """Deterministic ObjectID for a transfer (the segment NAME is the
+    capability, exactly like worker-created puts)."""
+    from ray_tpu.core.ids import ObjectID
+
+    return ObjectID(
+        hashlib.blake2b(
+            b"kvx:" + transfer_id.encode(), digest_size=ObjectID.SIZE
+        ).digest()
+    )
+
+
+#: published-but-unreleased exports: transfer_id -> (ObjectID, expiry)
+_EXPORTS: Dict[str, Tuple[Any, float]] = {}
+_EXPORTS_LOCK = threading.Lock()
+
+
+def _reap_exports(be) -> None:
+    now = time.monotonic()
+    with _EXPORTS_LOCK:
+        dead = [t for t, (_o, exp) in _EXPORTS.items() if now > exp]
+        victims = [_EXPORTS.pop(t)[0] for t in dead]
+    for oid in victims:
+        try:
+            be.io.run(
+                be.daemon.call("delete_object", {"object_id": oid.binary()}),
+                timeout=10,
+            )
+        except Exception:  # noqa: BLE001 — best-effort reap
+            pass
+
+
+def release_export(transfer_id: str) -> None:
+    """Explicitly drop a published export (the TTL reap is the backstop
+    for descriptors that never got consumed)."""
+    with _EXPORTS_LOCK:
+        ent = _EXPORTS.pop(transfer_id, None)
+    if ent is None:
+        return
+    be = _backend()
+    if be is None:
+        return
+    try:
+        be.io.run(
+            be.daemon.call("delete_object", {"object_id": ent[0].binary()}),
+            timeout=10,
+        )
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# -- publish (prefill side) -------------------------------------------------
+
+
+def publish(payload: Dict[str, Any], *, transfer_id: Optional[str] = None) -> Dict[str, Any]:
+    """Turn an ``engine.prefill_kv`` payload into a migration
+    descriptor. The KV array is written ONCE into a fresh shm segment
+    named after the transfer's ObjectID, which the local daemon adopts —
+    from then on it is a normal store object any daemon can pull with
+    full integrity/resume semantics. Raises :class:`KvTransferError`
+    when nothing can carry the payload (no daemon AND too big to
+    inline)."""
+    kv = np.ascontiguousarray(payload["kv"])
+    tokens: List[int] = list(payload["tokens"])
+    tid = transfer_id or uuid.uuid4().hex[:16]
+    desc: Dict[str, Any] = {
+        "transfer_id": tid,
+        "tokens": len(tokens),
+        "block_size": int(payload["block_size"]),
+        "shape": tuple(int(d) for d in kv.shape),
+        "dtype": str(kv.dtype),
+        "size": int(kv.nbytes),
+        "inline": None,
+        "object_id": None,
+        "source": None,
+    }
+    be = _backend()
+    if be is None:
+        cap = GLOBAL_CONFIG.kv_inline_max_bytes
+        if kv.nbytes > cap:
+            count_failure("export")
+            raise KvTransferError(
+                f"no node daemon and payload of {kv.nbytes} bytes exceeds "
+                f"kv_inline_max_bytes={cap}"
+            )
+        raw = kv.tobytes()
+        desc["inline"] = raw
+        desc["crc32"] = zlib.crc32(raw)
+        return desc
+    _reap_exports(be)
+    from ray_tpu.core.object_store import _attach, _create, segment_name
+
+    oid = _kv_object_id(tid)
+    name = segment_name(oid)
+    try:
+        try:
+            seg = _create(name, kv.nbytes)
+        except FileExistsError:
+            # transfer-id collision can't happen (uuid); a stale segment
+            # from a crashed exporter can — overwrite in place
+            seg = _attach(name)
+        try:
+            view = np.frombuffer(memoryview(seg.buf)[: kv.nbytes], dtype=kv.dtype)
+            view[:] = kv.reshape(-1)
+            desc["crc32"] = zlib.crc32(memoryview(seg.buf)[: kv.nbytes])
+            del view
+        finally:
+            seg.close()
+        be.io.run(
+            be.daemon.call(
+                "adopt_object", {"object_id": oid.binary(), "size": kv.nbytes}
+            ),
+            timeout=30,
+        )
+    except Exception as e:  # noqa: BLE001 — publish failure → fallback
+        count_failure("export")
+        raise KvTransferError(f"kv publish failed: {e!r}") from e
+    desc["object_id"] = oid.hex()
+    desc["source"] = tuple(be.daemon_addr)
+    with _EXPORTS_LOCK:
+        _EXPORTS[tid] = (
+            oid, time.monotonic() + GLOBAL_CONFIG.kv_export_ttl_s,
+        )
+    return desc
+
+
+# -- fetch (decode side) ----------------------------------------------------
+
+
+class FetchedPayload:
+    """A migrated KV array plus the cleanup that returns its segment.
+    ``close()`` is safe to call with the array still referenced (the
+    mapping outlives live views; the daemon-side delete recycles the
+    inode into the receive-segment pool either way)."""
+
+    def __init__(self, array: np.ndarray, close: Callable[[], None]):
+        self.array = array
+        self._close = close
+
+    def close(self) -> None:
+        try:
+            self._close()
+        except Exception:  # noqa: BLE001 — cleanup must never raise
+            pass
+
+
+def fetch(desc: Dict[str, Any], *, timeout_s: float = 30.0) -> FetchedPayload:
+    """Materialize a descriptor's KV payload locally. Remote descriptors
+    ride ``pull_object`` on the local daemon (RAW receive-into-segment,
+    per-chunk CRC, digest-verified seal, multi-source resume); the
+    store digest is then compared against the exporter-stamped CRC
+    before the array is handed to the importing engine — the
+    digest-before-attach gate, which also covers the same-node
+    short-circuit where no transfer ran."""
+    shape = tuple(desc["shape"])
+    dtype = np.dtype(desc["dtype"])
+    inline = desc.get("inline")
+    if inline is not None:
+        if zlib.crc32(inline) != desc["crc32"]:
+            count_failure("digest")
+            raise KvTransferError("inline kv payload failed its crc gate")
+        arr = np.frombuffer(inline, dtype=dtype).reshape(shape)
+        migration_metrics()["transfers"].inc()
+        migration_metrics()["bytes"].inc(len(inline))
+        return FetchedPayload(arr, lambda: None)
+    be = _backend()
+    if be is None:
+        count_failure("transfer")
+        raise KvTransferError("no node daemon to pull the kv payload through")
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store import _attach
+
+    oid = ObjectID(bytes.fromhex(desc["object_id"]))
+    try:
+        reply = be.io.run(
+            be.daemon.call(
+                "pull_object",
+                {
+                    "object_id": oid.binary(),
+                    "sources": [tuple(desc["source"])],
+                    "deadline_s": timeout_s,
+                },
+            ),
+            timeout=timeout_s + 15,
+        )
+    except Exception as e:  # noqa: BLE001
+        count_failure("transfer")
+        raise KvTransferError(f"kv pull failed: {e!r}") from e
+    if not (isinstance(reply, dict) and reply.get("segment")):
+        count_failure("transfer")
+        raise KvTransferError(f"kv pull failed: {reply!r}")
+    try:
+        info = be.io.run(
+            be.daemon.call("object_info", {"object_id": oid.binary()}),
+            timeout=30,
+        )
+    except Exception as e:  # noqa: BLE001
+        count_failure("digest")
+        raise KvTransferError(f"kv digest probe failed: {e!r}") from e
+    digest = (info or {}).get("digest")
+    if digest != desc["crc32"]:
+        count_failure("digest")
+        raise KvTransferError(
+            f"kv payload digest mismatch: store={digest} descriptor="
+            f"{desc['crc32']} — refusing to attach"
+        )
+    try:
+        seg = _attach(reply["segment"])
+    except Exception as e:  # noqa: BLE001
+        count_failure("transfer")
+        raise KvTransferError(f"kv segment attach failed: {e!r}") from e
+    view = memoryview(seg.buf)[: desc["size"]]
+    arr = np.frombuffer(view, dtype=dtype).reshape(shape)
+
+    def _close():
+        try:
+            view.release()
+        except BufferError:
+            pass  # live numpy views keep the mapping valid
+        try:
+            seg.close()
+        except Exception:  # noqa: BLE001
+            pass
+        # the received copy is private to this transfer: delete it and
+        # hand the inode to the daemon's receive-segment reuse pool so
+        # the NEXT migration skips segment create/zero entirely
+        try:
+            be.io.run(
+                be.daemon.call(
+                    "delete_object",
+                    {"object_id": oid.binary(), "recycle_receive": True},
+                ),
+                timeout=10,
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        # and release the SOURCE's export promptly — a consumed payload
+        # parked until the TTL reap would occupy the prefill replica's
+        # store for kv_export_ttl_s per migration, forcing spills of
+        # LIVE objects under sustained traffic. Best-effort: the TTL
+        # reap remains the backstop. (Same-node: the local delete above
+        # already dropped the shared entry; this is then a no-op.)
+        src = tuple(desc["source"])
+        if src != tuple(be.daemon_addr):
+            try:
+                be.io.run(
+                    be._client(src[0], src[1], role="noded").call(  # noqa: SLF001
+                        "delete_object", {"object_id": oid.binary()}
+                    ),
+                    timeout=10,
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+    migration_metrics()["transfers"].inc()
+    migration_metrics()["bytes"].inc(desc["size"])
+    return FetchedPayload(arr, _close)

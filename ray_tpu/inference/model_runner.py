@@ -24,13 +24,19 @@ import numpy as np
 from ray_tpu.models.llama import (
     LlamaConfig,
     copy_paged_blocks,
+    gather_paged_blocks,
     init_paged_kv_cache,
     paged_decode_step,
     paged_prefill_step,
+    scatter_paged_blocks,
 )
 
 #: block-copy pairs per compiled COW program (pairs pad with null->null)
 _COW_WIDTH = 4
+
+#: blocks per compiled KV gather/scatter program (KV-cache migration);
+#: short chunks pad with the null block so the shape never varies
+_KV_IO_WIDTH = 8
 
 
 def _round_up_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -87,13 +93,30 @@ class PagedModelRunner:
         self._copy_jit = jax.jit(
             partial(copy_paged_blocks), donate_argnums=cow_donate
         )
+        # KV-cache migration programs (disaggregated serving): the gather
+        # reads blocks out (export — never donated, the cache stays
+        # live), the scatter writes imported blocks in (donation like the
+        # COW copy). Compiled at warmup only when the engine opts in
+        # (kv_transfer_enabled), so plain deployments keep their exact
+        # compile_count; a lazy first use still works, it just shows up
+        # in recompiles_after_warmup.
+        self._gather_jit = jax.jit(partial(gather_paged_blocks))
+        self._scatter_jit = jax.jit(
+            partial(scatter_paged_blocks), donate_argnums=cow_donate
+        )
         self._seen_shapes: set = set()
         self._warmup_compiles: Optional[int] = None
 
     # -- compile accounting ----------------------------------------------
     def _jit_cache_entries(self) -> int:
         total = 0
-        for fn in (self._prefill_jit, self._decode_jit, self._copy_jit):
+        for fn in (
+            self._prefill_jit,
+            self._decode_jit,
+            self._copy_jit,
+            self._gather_jit,
+            self._scatter_jit,
+        ):
             size = getattr(fn, "_cache_size", None)
             if size is None:
                 return len(self._seen_shapes)
@@ -112,9 +135,13 @@ class PagedModelRunner:
     def compile_count(self) -> int:
         return self._jit_cache_entries()
 
-    def warmup(self, buckets_prefill=None, buckets_decode=None) -> None:
+    def warmup(
+        self, buckets_prefill=None, buckets_decode=None, *, kv_io: bool = False
+    ) -> None:
         """Compile every (or the given) bucket up front with trash inputs
-        aimed at the null block, then :meth:`mark_warm`."""
+        aimed at the null block, then :meth:`mark_warm`. ``kv_io`` also
+        compiles the KV-migration gather/scatter programs (disaggregated
+        serving opts in; plain engines keep their compile count)."""
         M = self.max_blocks_per_seq
         for c in buckets_prefill if buckets_prefill is not None else self.prefill_buckets:
             tokens = np.zeros(c, np.int32)
@@ -138,6 +165,12 @@ class PagedModelRunner:
         pad = np.zeros(_COW_WIDTH, np.int32)
         self.cache = self._copy_jit(self.cache, pad, pad)
         self._seen_shapes.add(("c", _COW_WIDTH))
+        if kv_io:
+            ids = np.zeros(_KV_IO_WIDTH, np.int32)
+            kv = np.asarray(self._gather_jit(self.cache, ids))
+            self.cache = self._scatter_jit(self.cache, ids, kv)
+            self._seen_shapes.add(("g", _KV_IO_WIDTH))
+            self._seen_shapes.add(("s", _KV_IO_WIDTH))
         self.mark_warm()
 
     # -- steps ------------------------------------------------------------
@@ -154,6 +187,44 @@ class PagedModelRunner:
                 src[j], dst[j] = s, d
             self._seen_shapes.add(("c", _COW_WIDTH))
             self.cache = self._copy_jit(self.cache, src, dst)
+
+    def gather_blocks(self, block_ids: Sequence[int]) -> np.ndarray:
+        """Read whole cache blocks to host (KV-migration export):
+        returns ``[2, n_layers, len(block_ids), block_size, n_kv,
+        head_dim]`` numpy in the cache dtype. Runs in _KV_IO_WIDTH
+        chunks padded with the null block so the compiled shape never
+        varies; padding rows are sliced off before concatenation."""
+        outs = []
+        for i in range(0, len(block_ids), _KV_IO_WIDTH):
+            chunk = block_ids[i : i + _KV_IO_WIDTH]
+            ids = np.zeros(_KV_IO_WIDTH, np.int32)
+            ids[: len(chunk)] = chunk
+            self._seen_shapes.add(("g", _KV_IO_WIDTH))
+            out = self._gather_jit(self.cache, ids)
+            outs.append(np.asarray(out)[:, :, : len(chunk)])
+        if not outs:
+            shape = self.cache["k"].shape  # [L, N, bs, kv, hd]
+            return np.zeros(
+                (2, shape[0], 0, shape[2], shape[3], shape[4]),
+                np.asarray(self.cache["k"]).dtype,
+            )
+        return np.concatenate(outs, axis=2) if len(outs) > 1 else outs[0]
+
+    def scatter_blocks(self, block_ids: Sequence[int], kv: np.ndarray) -> None:
+        """Write migrated KV blocks into this cache (KV-migration
+        import): ``kv`` is the :meth:`gather_blocks` layout, one row per
+        id in ``block_ids``. Short chunks pad with the null block (its
+        rows get zero-filled trash — inert by construction)."""
+        for i in range(0, len(block_ids), _KV_IO_WIDTH):
+            chunk = block_ids[i : i + _KV_IO_WIDTH]
+            ids = np.zeros(_KV_IO_WIDTH, np.int32)
+            ids[: len(chunk)] = chunk
+            buf = np.zeros(
+                kv.shape[:2] + (_KV_IO_WIDTH,) + kv.shape[3:], kv.dtype
+            )
+            buf[:, :, : len(chunk)] = kv[:, :, i : i + len(chunk)]
+            self._seen_shapes.add(("s", _KV_IO_WIDTH))
+            self.cache = self._scatter_jit(self.cache, ids, buf)
 
     def prefill_chunk(
         self,

@@ -58,6 +58,11 @@ class Request:
     #: request the step after its budget runs out
     deadline: object = None
     seed: Optional[int] = None
+    #: export-after-prefill mode (disaggregated serving): the request
+    #: finishes when its prompt K/V is fully written — no token is ever
+    #: sampled; the engine gathers the full blocks to host and hands the
+    #: payload to the waiting exporter instead
+    prefill_only: bool = False
 
     state: str = QUEUED
     #: prompt positions already written to the KV cache (chunked prefill

@@ -120,6 +120,20 @@ class LLMServer:
         changes across failovers."""
         r = self._parse(request)
         resume_from = r.get("resume_from")
+        desc = r.pop("kv_import", None)
+        if desc is not None and not resume_from:
+            # not resume_from: attempt 0 of a resumable stream carries
+            # resume_from=0 (the router stamps it on every attempt), and
+            # 0 delivered tokens means the prompt is still the original
+            # one the descriptor was exported for
+            # disaggregated handoff: install the prefill pool's KV
+            # blocks BEFORE submitting, so admission acquires them as a
+            # prefix hit (prefill_pos=cached; the 1-token tail rides the
+            # existing COW last-block rule). Any failure — transfer,
+            # digest, pool pressure, shape mismatch — degrades to a
+            # plain full prefill right here; the stream never fails
+            # because of the migration.
+            self._import_kv(desc, r["prompt"])
         if resume_from is None:
             yield from self.engine.generate(
                 r["prompt"],
@@ -173,6 +187,73 @@ class LLMServer:
     def __call__(self, request) -> Dict[str, Any]:
         """Non-streaming: returns the full generation in one reply."""
         return {"tokens": list(self.generate(request))}
+
+    # -- disaggregated prefill/decode (inference/kv_transfer.py) ----------
+    def prefill_export(self, request) -> Optional[Dict[str, Any]]:
+        """Prefill-pool entry of the disaggregated two-stage dispatch:
+        run ONLY the prompt's prefill (no token sampled), publish the
+        gathered KV blocks through the local daemon's store, and return
+        the migration descriptor the router attaches to the decode
+        dispatch. Returns None when the prompt spans no full block —
+        nothing worth migrating. Idempotent in effect: a retried export
+        publishes a fresh segment; unconsumed ones are TTL-reaped."""
+        from ray_tpu.inference import kv_transfer
+
+        r = self._parse(request)
+        payload = self.engine.prefill_kv(
+            r["prompt"],
+            priority=int(r.get("priority", 0)),
+            request_id=r.get("request_id"),
+        )
+        if payload is None:
+            return None
+        return kv_transfer.publish(payload)
+
+    def _import_kv(self, desc: Dict[str, Any], prompt) -> bool:
+        """Decode-pool half: fetch the descriptor's payload (zero-copy
+        pull path, digest-before-attach) and scatter it into this
+        engine's cache + radix index. Failure ladder: every exception is
+        swallowed into a counted fallback — the caller proceeds with a
+        plain prefill."""
+        from ray_tpu.inference import kv_transfer
+
+        eng = self.engine
+        try:
+            shape = tuple(desc.get("shape") or ())
+            cache_k = eng.runner.cache["k"]  # [L, N, bs, n_kv, hd]
+            expect = (
+                2, cache_k.shape[0], None, cache_k.shape[2],
+                cache_k.shape[3], cache_k.shape[4],
+            )
+            if (
+                len(shape) != 6
+                or int(desc.get("block_size") or 0) != eng.blocks.block_size
+                or any(e is not None and s != e for s, e in zip(shape, expect))
+                or str(desc.get("dtype")) != str(cache_k.dtype)
+            ):
+                kv_transfer.count_failure("shape")
+                kv_transfer.count_fallback("shape_mismatch")
+                return False
+            from ray_tpu.core.config import GLOBAL_CONFIG
+
+            fetched = kv_transfer.fetch(
+                desc, timeout_s=GLOBAL_CONFIG.serve_disagg_handoff_timeout_s
+            )
+            try:
+                covered = eng.import_kv_blocks(
+                    [int(t) for t in prompt[: int(desc["tokens"])]],
+                    fetched.array,
+                )
+            finally:
+                fetched.close()
+            return covered > 0
+        except kv_transfer.KvTransferError:
+            kv_transfer.count_fallback("transfer")
+            return False
+        except Exception:  # noqa: BLE001 — migration must never fail a stream
+            kv_transfer.count_failure("import")
+            kv_transfer.count_fallback("import")
+            return False
 
     def cancel(self, request_id: str) -> bool:
         """Cancel a queued/running request by id; frees its KV blocks.
@@ -238,6 +319,11 @@ def llm_deployment(
     seed: int = 0,
     autoscaling_config=None,
     version: Optional[str] = None,
+    disaggregated: bool = False,
+    prefill_replicas: int = 1,
+    decode_replicas: Optional[int] = None,
+    prefill_autoscaling_config=None,
+    prefill_actor_options: Optional[Dict[str, Any]] = None,
 ):
     """Build a Serve deployment serving ``model_cfg`` through a
     continuous-batching engine (the ``serve.llm`` entry point).
@@ -252,30 +338,108 @@ def llm_deployment(
     engines' gossiped admission-queue depth. Pin ``version`` to make a
     num_replicas redeploy an in-place scale instead of a rolling
     replacement (model code rarely changes between scale events; a
-    fresh replica warmup per scale step would)."""
+    fresh replica warmup per scale step would).
+
+    ``disaggregated=True`` splits prefill from decode onto two replica
+    pools (README "Disaggregated serving"): a sibling
+    ``{name}-prefill`` deployment (``prefill_replicas`` /
+    ``prefill_autoscaling_config`` / ``prefill_actor_options``) computes
+    prompt KV and exports it over the zero-copy data plane; the decode
+    pool (``decode_replicas``, default ``num_replicas``) imports the
+    blocks as prefix-cache hits and streams from a 1-token tail
+    prefill. ``serve.run(dep.bind())`` deploys BOTH pools; the returned
+    handle routes exactly as before (the two-stage dispatch lives in
+    the router, keyed off the deployment's ``disagg_prefill`` meta).
+    Both engines get ``kv_transfer_enabled`` forced on so migrations
+    never recompile. Handoff failures at every rung degrade to plain
+    single-replica generation — ``disaggregated`` changes the cost
+    profile, never the token stream (deterministic continuation makes
+    the handoff byte-exact by construction)."""
     from ray_tpu import serve
 
-    dep = serve.deployment(
-        name=name,
-        num_replicas=num_replicas,
-        max_concurrent_queries=max_concurrent_queries,
-        ray_actor_options=ray_actor_options,
-        route_prefix=route_prefix,
-        autoscaling_config=autoscaling_config,
-        version=version,
-    )(LLMServer)
+    if not disaggregated:
+        dep = serve.deployment(
+            name=name,
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            ray_actor_options=ray_actor_options,
+            route_prefix=route_prefix,
+            autoscaling_config=autoscaling_config,
+            version=version,
+        )(LLMServer)
 
-    class _BoundDeployment:
-        """Deployment with the model/engine config pre-bound."""
+        class _BoundDeployment:
+            """Deployment with the model/engine config pre-bound."""
 
-        def __init__(self, inner):
-            self._inner = inner
+            def __init__(self, inner):
+                self._inner = inner
+
+            def bind(self, **overrides):
+                kwargs = {"seed": seed, **overrides}
+                return self._inner.bind(model_cfg, engine, **kwargs)
+
+            def __getattr__(self, item):
+                return getattr(self._inner, item)
+
+        return _BoundDeployment(dep)
+
+    import dataclasses
+
+    from ray_tpu.inference.engine import EngineConfig
+    from ray_tpu.serve import Deployment, DisaggApplication
+    from ray_tpu.serve.config import DeploymentConfig
+
+    ec = engine or EngineConfig()
+    if not ec.kv_transfer_enabled:
+        ec = dataclasses.replace(ec, kv_transfer_enabled=True)
+    prefill_name = f"{name}-prefill"
+    decode_dep = Deployment(
+        LLMServer,
+        name,
+        DeploymentConfig(
+            num_replicas=decode_replicas or num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            ray_actor_options=dict(ray_actor_options or {}),
+            autoscaling=autoscaling_config,
+            route_prefix=route_prefix,
+            version=version,
+            disagg_prefill=prefill_name,
+        ),
+    )
+    prefill_dep = Deployment(
+        LLMServer,
+        prefill_name,
+        DeploymentConfig(
+            num_replicas=prefill_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            ray_actor_options=dict(
+                prefill_actor_options or ray_actor_options or {}
+            ),
+            autoscaling=prefill_autoscaling_config,
+            route_prefix=None,
+            version=version,
+        ),
+    )
+
+    class _BoundDisagg:
+        """Two-pool deployment bundle with the configs pre-bound.
+        ``bind()`` returns a :class:`serve.DisaggApplication` —
+        ``serve.run`` deploys the prefill pool first, then the decode
+        pool, and hands back the decode pool's handle."""
+
+        def __init__(self, decode, prefill):
+            self._decode = decode
+            self._prefill = prefill
 
         def bind(self, **overrides):
             kwargs = {"seed": seed, **overrides}
-            return self._inner.bind(model_cfg, engine, **kwargs)
+            app = DisaggApplication(
+                self._decode, (model_cfg, ec), dict(kwargs)
+            )
+            app.prefill_app = self._prefill.bind(model_cfg, ec, **kwargs)
+            return app
 
         def __getattr__(self, item):
-            return getattr(self._inner, item)
+            return getattr(self._decode, item)
 
-    return _BoundDeployment(dep)
+    return _BoundDisagg(decode_dep, prefill_dep)

@@ -383,6 +383,28 @@ def copy_paged_blocks(cache, src, dst):
     }
 
 
+def gather_paged_blocks(cache, blocks):
+    """Pull whole cache blocks off the device (KV-cache migration
+    export): ``blocks`` is [P] int32 block ids (padded with 0 = null);
+    returns one stacked array ``[2, n_layers, P, block_size, n_kv,
+    head_dim]`` (K at index 0, V at 1) — the contiguous host window the
+    transfer path ships replica→replica. Padding rows carry null-block
+    trash the caller slices off host-side."""
+    return jnp.stack([cache["k"][:, blocks], cache["v"][:, blocks]])
+
+
+def scatter_paged_blocks(cache, blocks, kv):
+    """Write migrated KV blocks into the device cache (import side of
+    KV-cache migration): ``kv`` is the ``gather_paged_blocks`` layout
+    ``[2, n_layers, P, block_size, n_kv, head_dim]``. Padding entries
+    point at the null block — duplicate index-0 writes land trash on
+    trash, keeping the compiled shape static and the content inert."""
+    return {
+        "k": cache["k"].at[:, blocks].set(kv[0]),
+        "v": cache["v"].at[:, blocks].set(kv[1]),
+    }
+
+
 def _rope_at(cfg: LlamaConfig, positions):
     """cos/sin tables at arbitrary int positions: [N] -> ([N, hd/2] x2)."""
     hd = cfg.head_dim

@@ -55,6 +55,20 @@ class Application:
         self.kwargs = kwargs
 
 
+class DisaggApplication(Application):
+    """A disaggregated two-pool application (decode deployment + its
+    paired prefill deployment, ``llm_deployment(disaggregated=True)``).
+    ``serve.run`` deploys ``prefill_app`` first, then this (decode)
+    application, and returns the decode handle — the router discovers
+    the pairing through the deployment's ``disagg_prefill`` meta, so
+    any handle to the decode deployment (including one built later by
+    an ingress replica) gets the two-stage dispatch."""
+
+    def __init__(self, deployment: "Deployment", args, kwargs):
+        super().__init__(deployment, args, kwargs)
+        self.prefill_app: Optional[Application] = None
+
+
 class Deployment:
     def __init__(self, cls_or_fn, name: str, config: DeploymentConfig):
         self._cls_or_fn = cls_or_fn
@@ -175,11 +189,23 @@ class DeploymentHandle:
 
 
 def run(app: Application, *, name: Optional[str] = None, _blocking_ready: bool = True) -> DeploymentHandle:
-    """Deploy an application; returns its handle (reference ``serve.run``)."""
+    """Deploy an application; returns its handle (reference ``serve.run``).
+    A :class:`DisaggApplication` deploys its prefill pool first, then
+    the decode pool, and returns the decode handle."""
     if isinstance(app, Deployment):
         app = app.bind()
-    dep = app.deployment
     controller = get_or_create_controller()
+    prefill = getattr(app, "prefill_app", None)
+    if prefill is not None:
+        pdep = prefill.deployment
+        ray_tpu.get(
+            controller.deploy.remote(
+                pdep.name, pdep._cls_or_fn, list(prefill.args),
+                dict(prefill.kwargs), pdep.config,
+            ),
+            timeout=120,
+        )
+    dep = app.deployment
     ray_tpu.get(
         controller.deploy.remote(
             dep.name, dep._cls_or_fn, list(app.args), dict(app.kwargs), dep.config
@@ -188,6 +214,11 @@ def run(app: Application, *, name: Optional[str] = None, _blocking_ready: bool =
     )
     handle = DeploymentHandle(dep.name, controller)
     if _blocking_ready:
+        if prefill is not None:
+            # the prefill pool must be routable too, or the first
+            # requests burn their whole handoff budget waiting on a
+            # replica that is still warming up
+            DeploymentHandle(pdep.name, controller)._router.choose_replica()
         handle._router.choose_replica()  # wait for ≥1 replica
     return handle
 
@@ -198,7 +229,17 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 
 def delete(name: str) -> None:
     controller = get_or_create_controller()
+    # disaggregated deployments pair with a prefill pool serve.run
+    # deployed alongside them — deleting only the decode pool would
+    # orphan full engine replicas until serve.shutdown()
+    try:
+        meta = ray_tpu.get(controller.deployment_meta.remote(name), timeout=30)
+        prefill = (meta or {}).get("disagg_prefill")
+    except Exception:
+        prefill = None
     ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+    if prefill:
+        ray_tpu.get(controller.delete_deployment.remote(prefill), timeout=60)
 
 
 def status() -> Dict[str, Dict[str, Any]]:
@@ -240,6 +281,7 @@ __all__ = [
     "Deployment",
     "DeploymentConfig",
     "DeploymentHandle",
+    "DisaggApplication",
     "delete",
     "deployment",
     "get_deployment_handle",

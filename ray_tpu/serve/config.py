@@ -34,3 +34,9 @@ class DeploymentConfig:
     #: redeploying the SAME version is an in-place config update;
     #: a different (or absent) version rolls replicas start-before-kill
     version: Optional[str] = None
+    #: disaggregated prefill/decode serving: name of the PREFILL-pool
+    #: deployment paired with this (decode) deployment. Routers read it
+    #: through ``deployment_meta`` and run the two-stage dispatch —
+    #: prefill_export on the prefill pool, then the stream on this pool
+    #: with the KV descriptor attached (inference/serve_llm.py).
+    disagg_prefill: Optional[str] = None

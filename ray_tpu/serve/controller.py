@@ -95,6 +95,10 @@ class _ServeController:
         # draining list) so routers drop them, in-flight requests finish,
         # and replacements start — all before the kill lands.
         self._draining_nodes: set = set()
+        #: ingress-door key -> {tenant: bucket state}: the timer-pushed
+        #: token-bucket persistence table (survives ingress replica
+        #: restarts; this controller outlives its replicas)
+        self._ingress_buckets: Dict[str, Dict[str, Dict[str, float]]] = {}
         #: replica actor_id -> node_id cache (stable: replicas don't move)
         self._replica_nodes: Dict[bytes, bytes] = {}
         try:
@@ -346,6 +350,60 @@ class _ServeController:
                 str(m)
                 for m in (getattr(st.cls_or_fn, "resumable_streams", ()) or ())
             ]
+
+    def deployment_meta(self, name: str) -> Dict[str, Any]:
+        """Code/config properties a router needs once per deployment
+        (cached router-side with a TTL): the resumable-streams
+        declaration plus the paired prefill-pool name for disaggregated
+        serving. One RPC instead of one per property."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return {"resumable_streams": [], "disagg_prefill": None}
+            return {
+                "resumable_streams": [
+                    str(m)
+                    for m in (
+                        getattr(st.cls_or_fn, "resumable_streams", ()) or ()
+                    )
+                ],
+                "disagg_prefill": st.config.disagg_prefill,
+            }
+
+    # -- ingress bucket persistence (serve/ingress.py satellite) ---------
+    #: per-door cap on remembered tenants — newest-stamp entries win
+    _MAX_BUCKET_TENANTS = 4096
+
+    def save_ingress_buckets(
+        self, key: str, buckets: Dict[str, Dict[str, float]]
+    ) -> bool:
+        """Timer-pushed per-tenant token-bucket fill levels from an
+        ingress replica (``{"level": ..., "wall": time.time()}`` per
+        tenant). Merged per tenant by NEWEST wall stamp — tenants
+        rendezvous onto one door, so cross-replica conflicts are rare
+        and recency is the right tiebreak. A replacement replica
+        restores from here instead of refilling every tenant's burst."""
+        with self._lock:
+            table = self._ingress_buckets.setdefault(key, {})
+            for tenant, state in buckets.items():
+                cur = table.get(tenant)
+                if cur is None or float(state.get("wall", 0.0)) >= float(
+                    cur.get("wall", 0.0)
+                ):
+                    table[tenant] = dict(state)
+            if len(table) > self._MAX_BUCKET_TENANTS:
+                for victim in sorted(
+                    table, key=lambda t: float(table[t].get("wall", 0.0))
+                )[: len(table) - self._MAX_BUCKET_TENANTS]:
+                    del table[victim]
+        return True
+
+    def load_ingress_buckets(self, key: str) -> Dict[str, Dict[str, float]]:
+        """Snapshot for a (re)starting ingress replica."""
+        with self._lock:
+            return {
+                t: dict(s) for t, s in self._ingress_buckets.get(key, {}).items()
+            }
 
     def routes(self) -> Dict[str, str]:
         """route_prefix -> deployment name (proxy routing table)."""

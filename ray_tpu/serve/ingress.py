@@ -148,6 +148,12 @@ class IngressConfig:
     #: default pool, whose min(32, cpus+4) workers would otherwise cap
     #: concurrency far below max_concurrent_queries)
     max_concurrent_streams: int = 64
+    #: bucket-persistence key (set by ``ingress_deployment`` to the door
+    #: deployment's name): per-tenant token-bucket fill levels snapshot
+    #: to the serve controller on a timer and are restored by a
+    #: replacement replica — a restart no longer refills every tenant's
+    #: budget. None (standalone/driver use) disables persistence.
+    snapshot_key: Optional[str] = None
 
     def resolved_rate(self, pol: TenantPolicy) -> float:
         if pol.rate is not None:
@@ -228,6 +234,21 @@ class HttpIngress:
         self._router = handle._router
         self._lock = threading.Lock()
         self._buckets: Dict[str, TokenBucket] = {}
+        #: restored bucket states from the serve controller (tenant ->
+        #: {"level", "wall"}), consumed lazily as tenants re-appear —
+        #: refill since the snapshot is credited at consumption time
+        self._bucket_seed: Dict[str, Dict[str, float]] = {}
+        self._snapshot_stop = threading.Event()
+        if self.cfg.snapshot_key:
+            self._restore_buckets()
+            period = GLOBAL_CONFIG.serve_ingress_bucket_snapshot_period_s
+            if period > 0:
+                threading.Thread(
+                    target=self._bucket_snapshot_loop,
+                    args=(period,),
+                    daemon=True,
+                    name="ingress-bucket-snapshot",
+                ).start()
         #: local mirrors of the prometheus counters — gossiped to the
         #: serve controller (routing_stats) and returned by debug_stats
         #: so tests/operators read them without scraping /metrics
@@ -298,6 +319,51 @@ class HttpIngress:
 
         return web.json_response({"ok": True})
 
+    # -- bucket persistence (the restart-refill fix) ----------------------
+    def _restore_buckets(self) -> None:
+        """Pull the door's persisted bucket table from the serve
+        controller. Best-effort: an unreachable controller means fresh
+        buckets (the pre-persistence behavior), never a failed start."""
+        import ray_tpu
+
+        try:
+            controller = self._target_handle._controller
+            self._bucket_seed = dict(
+                ray_tpu.get(
+                    controller.load_ingress_buckets.remote(
+                        self.cfg.snapshot_key
+                    ),
+                    timeout=10,
+                )
+                or {}
+            )
+        except Exception:  # noqa: BLE001
+            self._bucket_seed = {}
+
+    def _bucket_snapshot_loop(self, period: float) -> None:
+        """Timer push of per-tenant fill levels. Wall-clock stamps, not
+        monotonic — the restoring replica is a different process, and it
+        credits refill for the downtime from the stamp."""
+        controller = self._target_handle._controller
+        while not self._snapshot_stop.wait(period):
+            try:
+                now_mono = time.monotonic()
+                now_wall = time.time()
+                with self._lock:
+                    snap = {
+                        tenant: {
+                            "level": b.level,
+                            "wall": now_wall - (now_mono - b.stamp),
+                        }
+                        for tenant, b in self._buckets.items()
+                    }
+                if snap:
+                    controller.save_ingress_buckets.remote(
+                        self.cfg.snapshot_key, snap
+                    )
+            except Exception:  # noqa: BLE001 — drop the tick, keep looping
+                pass
+
     # -- accounting -------------------------------------------------------
     def _count(self, tenant_class: str, outcome: str) -> None:
         requests, _shed, _ttfb = _ingress_metrics()
@@ -340,6 +406,18 @@ class HttpIngress:
                 bucket = self._buckets[tenant] = TokenBucket(
                     self.cfg.resolved_rate(pol), self.cfg.resolved_burst(pol)
                 )
+                seed = self._bucket_seed.pop(tenant, None)
+                if seed is not None:
+                    # resume the persisted fill level, crediting refill
+                    # for the time since the snapshot — a restart must
+                    # not hand a depleted tenant a fresh burst, nor
+                    # freeze its refill clock
+                    bucket.level = min(
+                        bucket.burst,
+                        float(seed.get("level", bucket.burst))
+                        + max(0.0, time.time() - float(seed.get("wall", 0.0)))
+                        * bucket.rate,
+                    )
             return bucket.try_take(cost)
 
     def _budget(self, request, body: Dict[str, Any]) -> float:
@@ -557,6 +635,7 @@ class HttpIngress:
         return self._thread.is_alive() and self._startup_error is None
 
     def stop(self) -> None:
+        self._snapshot_stop.set()
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
         self._exec.shutdown(wait=False)
@@ -610,13 +689,15 @@ def ingress_deployment(
 
     # the explicit ``target`` argument always names the downstream
     # deployment; the caller's config object is never mutated (one
-    # IngressConfig can parameterize several doors)
-    if config is None:
-        cfg = IngressConfig(target=target)
-    else:
-        import dataclasses
+    # IngressConfig can parameterize several doors). The door's own
+    # deployment name keys bucket persistence: every replica of this
+    # door shares (and a replacement restores) one tenant-bucket table.
+    import dataclasses
 
-        cfg = dataclasses.replace(config, target=target)
+    if config is None:
+        cfg = IngressConfig(target=target, snapshot_key=name)
+    else:
+        cfg = dataclasses.replace(config, target=target, snapshot_key=name)
     dep = serve.deployment(
         name=name,
         num_replicas=num_replicas,

@@ -163,10 +163,14 @@ class Router:
         self._local_tokens: Dict[Any, float] = {}
         self._poller_started = False
         self._poller_lock = threading.Lock()
-        #: streaming methods the deployment declared replay-safe
-        #: (fetched lazily from the serve controller, cached with a TTL)
-        self._resumable: Optional[frozenset] = None
-        self._resumable_fetched_at = 0.0
+        #: deployment meta (resumable_streams declaration + paired
+        #: disagg prefill pool), fetched lazily from the serve
+        #: controller and cached with a TTL
+        self._meta: Optional[Dict[str, Any]] = None
+        self._meta_fetched_at = 0.0
+        #: lazily-built router for the paired prefill-pool deployment
+        #: (disaggregated serving two-stage dispatch)
+        self._prefill_router: Optional["Router"] = None
         self._closed = False
 
     def close(self) -> None:
@@ -248,15 +252,15 @@ class Router:
                 self._have_replicas.clear()
 
     # -- choice ----------------------------------------------------------
-    def choose_replica(self, model_id: str = "", request_args=None):
+    def choose_replica(self, model_id: str = "", request_args=None, wait_s: float = 30.0):
         self._ensure_poller()
-        if not self._have_replicas.wait(timeout=30):
+        if not self._have_replicas.wait(timeout=wait_s):
             raise RuntimeError(f"no replicas for deployment {self._deployment!r}")
         with self._replicas_lock:
             replicas = list(self._replicas)
         if not replicas:
             # raced a scale-to-zero push
-            return self.choose_replica(model_id, request_args)
+            return self.choose_replica(model_id, request_args, wait_s)
         if model_id:
             # model-aware: prefer replicas the controller says already
             # hold the model (replica-pushed, so no stats-TTL staleness)
@@ -504,7 +508,13 @@ class Router:
         # replica-side spans parent to this one
         with _tracing.root_span(f"serve::{self._deployment}.{method}", "serve"):
             while not deadline.expired:
-                replica = self.choose_replica(model_id, args)
+                # the replica wait honors the SAME deadline as the call:
+                # blocking 30s for a replacement inside a 2s-budget call
+                # and then dispatching anyway would return results after
+                # the caller's deadline instead of failing it honestly
+                replica = self.choose_replica(
+                    model_id, args, wait_s=max(1.0, deadline.remaining())
+                )
                 self._bump(replica)
                 try:
                     ref = replica.handle_request.remote(
@@ -533,25 +543,21 @@ class Router:
         )
 
     # -- resumable streams -------------------------------------------------
-    def _resumable_methods(self) -> frozenset:
-        """Streaming methods the deployment's callable declared
-        replay-safe (``resumable_streams`` class attribute), read from
-        the serve controller and cached with a TTL — the declaration is
-        a property of the deployed CODE, which a redeploy can change
-        under a long-lived handle."""
-        cached = self._resumable
+    def _deployment_meta(self) -> Dict[str, Any]:
+        """Deployment code/config meta (resumable-streams declaration +
+        paired disagg prefill pool), read from the serve controller and
+        cached with a TTL — both are properties of the deployed CODE/
+        CONFIG, which a redeploy can change under a long-lived handle."""
+        cached = self._meta
         if (
             cached is not None
-            and time.monotonic() - self._resumable_fetched_at
-            < _RESUMABLE_META_TTL_S
+            and time.monotonic() - self._meta_fetched_at < _RESUMABLE_META_TTL_S
         ):
             return cached
         try:
-            methods = frozenset(
+            meta = dict(
                 ray_tpu.get(
-                    self._controller.resumable_stream_methods.remote(
-                        self._deployment
-                    ),
+                    self._controller.deployment_meta.remote(self._deployment),
                     timeout=10,
                 )
             )
@@ -559,10 +565,76 @@ class Router:
             # controller briefly unreachable (failover): serve the stale
             # cache if there is one, else the legacy contract — and
             # retry on the next call either way
-            return cached if cached is not None else frozenset()
-        self._resumable = methods
-        self._resumable_fetched_at = time.monotonic()
-        return methods
+            return cached if cached is not None else {
+                "resumable_streams": [], "disagg_prefill": None,
+            }
+        self._meta = meta
+        self._meta_fetched_at = time.monotonic()
+        return meta
+
+    def _resumable_methods(self) -> frozenset:
+        return frozenset(self._deployment_meta().get("resumable_streams") or ())
+
+    # -- disaggregated prefill/decode handoff ------------------------------
+    def _disagg_handoff(
+        self,
+        prefill_dep: str,
+        req: Dict[str, Any],
+        model_id: str,
+        caller_budget: Optional[float] = None,
+    ) -> None:
+        """Two-stage dispatch, stage one: run the prompt's prefill on
+        the PREFILL pool (scored dispatch like any request) and attach
+        the returned KV descriptor, so the decode-pool replica imports
+        the prompt KV instead of recomputing it. Every failure rung —
+        short prompt, prefill-pool death, handoff timeout, empty export
+        — degrades to plain single-replica generation (the descriptor
+        simply isn't attached) and is counted on
+        ``raytpu_kv_migration_fallbacks_total``; the stream itself never
+        fails because of the handoff."""
+        from ray_tpu.inference.kv_transfer import (
+            count_fallback,
+            migration_metrics,
+        )
+
+        prompt = req.get("prompt") or []
+        if len(prompt) < GLOBAL_CONFIG.serve_disagg_min_prompt_tokens:
+            count_fallback("short_prompt")
+            return
+        with self._replicas_lock:
+            pr = self._prefill_router
+        if pr is None or pr._deployment != prefill_dep:
+            pr = Router(self._controller, prefill_dep)
+            with self._replicas_lock:
+                self._prefill_router = pr
+        # the handoff spends the CALLER's budget: blocking the full
+        # handoff timeout inside a shorter-deadline stream would delay
+        # the decode dispatch past the point the caller already gave up
+        # (the same contract the choose_replica clamp enforces)
+        handoff_timeout = GLOBAL_CONFIG.serve_disagg_handoff_timeout_s
+        if caller_budget is not None:
+            handoff_timeout = min(handoff_timeout, caller_budget)
+        t0 = time.monotonic()
+        try:
+            desc = pr.execute(
+                "prefill_export",
+                [{
+                    "prompt": [int(t) for t in prompt],
+                    "priority": int(req.get("priority", 0)),
+                    "request_id": f"{req['request_id']}.pf",
+                }],
+                {},
+                model_id=model_id,
+                timeout=handoff_timeout,
+            )
+        except Exception:  # noqa: BLE001 — any handoff failure → fallback
+            count_fallback("prefill_dispatch")
+            return
+        if not desc:
+            count_fallback("empty_export")
+            return
+        req["kv_import"] = desc
+        migration_metrics()["handoff"].observe(time.monotonic() - t0)
 
     def execute_stream(
         self,
@@ -608,7 +680,9 @@ class Router:
         # window); the replica's streaming task span parents to it
         with _tracing.root_span(f"serve::{self._deployment}.{method}", "serve"):
             while not deadline.expired:
-                replica = self.choose_replica(model_id, args)
+                replica = self.choose_replica(
+                    model_id, args, wait_s=max(1.0, deadline.remaining())
+                )
                 self._bump(replica)
                 gen = replica.handle_request_streaming.options(
                     num_returns="streaming"
@@ -681,6 +755,13 @@ class Router:
             # engine's id-derived fallback seed would also work, but an
             # explicit stamp survives request_id suffixing across attempts
             req["seed"] = int.from_bytes(os.urandom(4), "little")
+        # disaggregated serving: compute the prompt KV on the prefill
+        # pool first, attach the migration descriptor for the decode
+        # replica (identity is already pinned, so the handoff changes
+        # WHERE the prefill runs, never what the client sees)
+        prefill_dep = self._deployment_meta().get("disagg_prefill")
+        if prefill_dep and "kv_import" not in req:
+            self._disagg_handoff(prefill_dep, req, model_id, budget)
         base_prompt = [int(t) for t in req["prompt"]]
         base_rid = str(req["request_id"])
         gate = SeqGate(0)
@@ -701,6 +782,11 @@ class Router:
                     # the resume as a duplicate submission)
                     attempt_req["prompt"] = base_prompt + delivered
                     attempt_req["request_id"] = f"{base_rid}.r{attempt}"
+                    # the KV descriptor belongs to attempt 0's dispatch:
+                    # a resume survivor warm-replays through its own
+                    # radix cache (PR 10); re-importing would add a
+                    # transfer to the failover path for nothing
+                    attempt_req.pop("kv_import", None)
                 # per-attempt budget: a resume is a fresh dispatch +
                 # time-to-next-token window, not a continuation of the
                 # first attempt's (possibly spent) dispatch budget

@@ -211,11 +211,25 @@ class SeededPlanCache:
 #:   router's seq-numbered resume protocol exists for.
 #: kill_mid_prefill — SIGKILL before a planned prefill chunk runs
 #:   (exercises resume before/while the first token is produced).
+#: kill_mid_export — SIGKILL while a prefill replica is gathering a
+#:   request's KV blocks for migration (disaggregated serving): the
+#:   exported segment never publishes, the router's handoff dispatch
+#:   fails, and the request must degrade to plain generation.
+#: kill_mid_import — SIGKILL while a decode replica is scattering
+#:   migrated KV into its cache: the stream dies before its first token
+#:   and the resumable-stream machinery replays it (without the
+#:   descriptor) on a survivor.
 #: stall — the step loop sleeps ``param`` seconds mid-step: the actor's
 #:   async loop keeps answering RPCs while the engine wedges, which is
 #:   exactly what the serve controller's health poll (not liveness
 #:   checks) must catch and restart.
-REPLICA_FAULT_MODES = ("kill_mid_decode", "kill_mid_prefill", "stall")
+REPLICA_FAULT_MODES = (
+    "kill_mid_decode",
+    "kill_mid_prefill",
+    "kill_mid_export",
+    "kill_mid_import",
+    "stall",
+)
 
 
 class ReplicaFaultPlan:

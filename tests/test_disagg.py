@@ -1,0 +1,411 @@
+"""ISSUE 13: disaggregated prefill/decode serving — cross-replica
+KV-cache migration over the zero-copy data plane.
+
+Acceptance gates covered here:
+
+* **byte-exact handoff** — a temperature>0 request prefilled on the
+  prefill pool and decoded on the decode pool yields the IDENTICAL
+  token sequence to the same request run end-to-end on one engine
+  (deterministic continuation makes the handoff exact by construction),
+  with the migration provably used (decode-replica prefix hit +
+  ``raytpu_kv_migration_transfers_total``);
+* **failure → fallback ladder** — a corrupted descriptor (digest
+  mismatch) degrades to a plain full prefill with the fallback counted,
+  never a wrong or failed stream;
+* **seeded replica chaos** — ``kill_mid_export`` on the prefill replica
+  and ``kill_mid_import`` on the decode replica (the new
+  ``ReplicaFaultPlan`` consult points): every stream stays byte-exact
+  vs the undisturbed single-engine reference, zero client errors,
+  fallback counter > 0 for the export kill, and the fault schedule
+  replays deterministically from the logged seed;
+* **radix-spine gossip** — the compacted ``prefix_digest`` export keeps
+  ancestor chains intact under budget (the satellite's contract).
+"""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+
+from ray_tpu.inference.engine import EngineConfig, InferenceEngine  # noqa: E402
+from ray_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+
+#: 24 tokens = 3 full blocks at block_size 8 — enough to migrate, small
+#: enough that every test stays CI-cheap
+PROMPT = [5, 9, 2, 7, 1, 3, 8, 4] * 3
+
+CHAOS_SEED = 1307
+
+
+def _engine_cfg():
+    # warmup=False + minimal buckets: every replica incarnation (and
+    # the chaos tests spawn replacements) compiles only the programs a
+    # request actually uses — the suite-runtime budget matters more
+    # here than the zero-recompile property (asserted elsewhere)
+    return EngineConfig(
+        num_blocks=64, block_size=8, prefill_buckets=(8, 32),
+        decode_buckets=(1, 2), max_decode_batch=2,
+        max_new_tokens_default=8, warmup=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def disagg_handle():
+    ray_tpu.init(num_cpus=4)
+    dep = serve.llm_deployment(
+        LlamaConfig.tiny(), engine=_engine_cfg(), name="dllm",
+        disaggregated=True, prefill_replicas=1, decode_replicas=1,
+        route_prefix="/dllm", ray_actor_options={"num_cpus": 0.25},
+    )
+    handle = serve.run(dep.bind())
+    yield handle
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def reference_engine():
+    """Undisturbed single-engine reference: same params seed + engine
+    config as every replica, so identical requests must produce
+    identical tokens."""
+    cfg = LlamaConfig.tiny()
+    eng = InferenceEngine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0)), _engine_cfg()
+    ).start()
+    yield eng
+    eng.stop()
+
+
+def _controller():
+    return ray_tpu.get_actor("__serve_controller__")
+
+
+def _replicas(name):
+    return ray_tpu.get(_controller().get_replicas.remote(name), timeout=30)
+
+
+def _replica_metrics(replica) -> str:
+    addr = ray_tpu.get(
+        replica.handle_request.remote("metrics_address", [], {}, ""),
+        timeout=60,
+    )
+    return urllib.request.urlopen(
+        f"http://{addr}/metrics", timeout=10
+    ).read().decode()
+
+
+def _stream(handle, req, timeout=120):
+    return list(handle.stream(dict(req), _method="generate", _timeout=timeout))
+
+
+# ---------------------------------------------------------------------------
+# happy path
+
+
+def test_byte_exact_handoff_with_sampling(disagg_handle, reference_engine):
+    """The acceptance gate: prefill on replica pool A, decode on pool B,
+    token stream identical to one engine doing both — at temperature>0,
+    where any handoff drift (lost positions, re-seeded sampling, partial
+    KV) would fork the stream immediately."""
+    req = {
+        "prompt": PROMPT, "max_new_tokens": 8,
+        "temperature": 0.8, "seed": 42,
+    }
+    out = _stream(disagg_handle, req)
+    ref = list(
+        reference_engine.generate(
+            PROMPT, max_new_tokens=8, temperature=0.8, seed=42
+        )
+    )
+    assert out == ref, (out, ref)
+
+    # the migration was USED, not silently fallen back from: the decode
+    # replica admitted the request as a prefix hit over imported blocks
+    # (23 of 24 prompt tokens skipped; the COW tail recomputed one)
+    decode = _replicas("dllm")[0]
+    stats = ray_tpu.get(
+        decode.handle_request.remote("engine_stats", [], {}, ""), timeout=60
+    )
+    ps = stats["prefix_cache"]
+    assert ps["hits_total"] >= 1, ps
+    assert ps["tokens_saved_total"] >= len(PROMPT) - 1, ps
+    body = _replica_metrics(decode)
+    transfers = [
+        float(line.rsplit(" ", 1)[1])
+        for line in body.splitlines()
+        if line.startswith("raytpu_kv_migration_transfers_total ")
+    ]
+    assert transfers and transfers[0] >= 1, transfers
+    # and the prefill pool actually ran the prompt's prefill
+    prefill = _replicas("dllm-prefill")[0]
+    pstats = ray_tpu.get(
+        prefill.handle_request.remote("engine_stats", [], {}, ""), timeout=60
+    )
+    assert pstats["scheduler"]["total_admitted"] >= 1
+    # router-side handoff latency was observed (driver-process registry)
+    from ray_tpu.inference.kv_transfer import migration_metrics
+
+    hist = migration_metrics()["handoff"]
+    assert sum(ent[-1] for ent in hist._values.values()) >= 1  # noqa: SLF001
+
+
+def test_greedy_handoff_matches_and_reuses_radix(disagg_handle, reference_engine):
+    """Greedy decode across the handoff, twice: the second request's
+    prefill-pool export is near-free (its own radix cache) and the
+    decode pool hits the already-imported blocks."""
+    req = {"prompt": PROMPT, "max_new_tokens": 6}
+    out1 = _stream(disagg_handle, req)
+    out2 = _stream(disagg_handle, req)
+    ref = list(reference_engine.generate(PROMPT, max_new_tokens=6))
+    assert out1 == ref and out2 == ref, (out1, out2, ref)
+
+
+# ---------------------------------------------------------------------------
+# failure → fallback ladder
+
+
+def test_digest_mismatch_falls_back_to_plain_prefill(disagg_handle, reference_engine):
+    """A descriptor whose payload fails the digest-before-attach gate
+    must degrade to a full prefill — correct tokens, counted fallback,
+    no stream error."""
+    prefill = _replicas("dllm-prefill")[0]
+    desc = ray_tpu.get(
+        prefill.handle_request.remote(
+            "prefill_export",
+            [{"prompt": PROMPT, "request_id": "corrupt.pf"}], {}, "",
+        ),
+        timeout=120,
+    )
+    assert desc is not None
+    desc = dict(desc)
+    desc["crc32"] = (desc["crc32"] ^ 0xFF) & 0xFFFFFFFF
+    decode = _replicas("dllm")[0]
+    out = ray_tpu.get(
+        decode.handle_request.remote(
+            "__call__",
+            [{"prompt": PROMPT, "max_new_tokens": 4, "kv_import": desc}],
+            {}, "",
+        ),
+        timeout=120,
+    )
+    ref = list(reference_engine.generate(PROMPT, max_new_tokens=4))
+    assert out["tokens"] == ref
+    body = _replica_metrics(decode)
+    assert 'raytpu_kv_migration_fallbacks_total{reason="transfer"}' in body
+    assert 'raytpu_kv_migration_failures_total{stage="digest"}' in body
+
+
+def test_short_prompt_skips_migration(disagg_handle, reference_engine):
+    """Prompts under serve_disagg_min_prompt_tokens never pay the
+    handoff — counted as a short_prompt fallback, stream still exact."""
+    from ray_tpu.inference.kv_transfer import migration_metrics
+
+    fallbacks = migration_metrics()["fallbacks"]
+    before = fallbacks._values.get(("short_prompt",), 0.0)  # noqa: SLF001
+    req = {"prompt": [3, 1, 4], "max_new_tokens": 4}
+    out = _stream(disagg_handle, req)
+    ref = list(reference_engine.generate([3, 1, 4], max_new_tokens=4))
+    assert out == ref
+    assert fallbacks._values.get(("short_prompt",), 0.0) > before  # noqa: SLF001
+
+
+# ---------------------------------------------------------------------------
+# seeded replica chaos (the new export/import consult points)
+
+
+def test_chaos_kill_prefill_mid_export_degrades_gracefully(
+    disagg_handle, reference_engine
+):
+    """SIGKILL the prefill replica at its export consult: every stream
+    must complete byte-exact via the fallback ladder (handoff fails →
+    plain generation on the decode pool), zero client errors, fallback
+    counter advanced, and the controller replaces the dead replica."""
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.inference.kv_transfer import migration_metrics
+
+    prefill = _replicas("dllm-prefill")[0]
+    ray_tpu.get(
+        prefill.handle_request.remote(
+            "testing_arm_replica_chaos",
+            ["kill_mid_export:1.0", CHAOS_SEED], {}, "",
+        ),
+        timeout=60,
+    )
+    fallbacks = migration_metrics()["fallbacks"]
+    before = sum(fallbacks._values.values())  # noqa: SLF001
+    old_timeout = GLOBAL_CONFIG.serve_disagg_handoff_timeout_s
+    # the replacement replica is unarmed, so an unbounded handoff budget
+    # would eventually succeed via retry; a tight budget pins the
+    # fallback rung this test asserts (production keeps the retry)
+    GLOBAL_CONFIG.serve_disagg_handoff_timeout_s = 2.0
+    try:
+        n = 3
+        results, errors = {}, {}
+
+        def consume(i):
+            try:
+                results[i] = _stream(
+                    disagg_handle,
+                    {
+                        "prompt": PROMPT, "max_new_tokens": 6,
+                        "temperature": 0.7, "seed": 100 + i,
+                    },
+                )
+            except Exception as e:  # noqa: BLE001
+                errors[i] = e
+
+        threads = [
+            threading.Thread(target=consume, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        for i in range(n):
+            ref = list(
+                reference_engine.generate(
+                    PROMPT, max_new_tokens=6, temperature=0.7, seed=100 + i
+                )
+            )
+            assert results[i] == ref, (i, results[i], ref)
+        assert sum(fallbacks._values.values()) > before  # noqa: SLF001
+    finally:
+        GLOBAL_CONFIG.serve_disagg_handoff_timeout_s = old_timeout
+    # the controller replaces the killed prefill replica
+    st = ray_tpu.get(
+        _controller().wait_status.remote(
+            "dllm-prefill", min_replicas=1, timeout_s=90
+        ),
+        timeout=120,
+    )
+    assert st["replicas"] >= 1, st
+
+
+def test_chaos_kill_decode_mid_import_resumes_byte_exact(
+    disagg_handle, reference_engine
+):
+    """SIGKILL the decode replica at its import consult: the stream dies
+    before its first token and the PR 10 resumable-stream machinery
+    replays it (descriptor stripped) on the replacement — byte-exact,
+    zero client errors."""
+    # the prefill pool must be healthy again after the previous test
+    ray_tpu.get(
+        _controller().wait_status.remote(
+            "dllm-prefill", min_replicas=1, timeout_s=90
+        ),
+        timeout=120,
+    )
+    decode = _replicas("dllm")[0]
+    ray_tpu.get(
+        decode.handle_request.remote(
+            "testing_arm_replica_chaos",
+            ["kill_mid_import:1.0", CHAOS_SEED + 1], {}, "",
+        ),
+        timeout=60,
+    )
+    out = _stream(
+        disagg_handle,
+        {
+            "prompt": PROMPT, "max_new_tokens": 6,
+            "temperature": 0.9, "seed": 777,
+        },
+        timeout=180,
+    )
+    ref = list(
+        reference_engine.generate(
+            PROMPT, max_new_tokens=6, temperature=0.9, seed=777
+        )
+    )
+    assert out == ref, (out, ref)
+    st = ray_tpu.get(
+        _controller().wait_status.remote("dllm", min_replicas=1, timeout_s=90),
+        timeout=120,
+    )
+    assert st["replicas"] >= 1, st
+
+
+def test_fault_schedule_replays_from_seed():
+    """The determinism contract the chaos tests lean on: one RNG draw
+    per consult ⇒ the injection schedule is a pure function of (seed,
+    consulted-phase sequence) — a failure log carrying the seed replays
+    the exact run."""
+    from ray_tpu.util.chaos import ReplicaFaultPlan
+
+    phases = ["prefill", "export", "decode", "import", "export", "decode"]
+    spec = "kill_mid_export:0.5:0:3,kill_mid_import:0.5:0:3"
+
+    def schedule():
+        plan = ReplicaFaultPlan(spec, CHAOS_SEED)
+        return [plan.consult(p) for p in phases]
+
+    assert schedule() == schedule()
+    assert any(f is not None for f in schedule())  # the seed does inject
+
+
+# ---------------------------------------------------------------------------
+# radix-spine gossip (digest compaction satellite)
+
+
+def test_prefix_digest_exports_complete_spines_under_budget():
+    """Under a budget smaller than the index, the gossip export must
+    consist of root-anchored chains (every exported digest's ancestors
+    exported with it) — the consecutive-prefix matcher can't use
+    orphans. The old flat recent-N slice violated exactly this."""
+    from ray_tpu.inference.kv_cache import (
+        PagedBlockManager,
+        prefix_block_hashes,
+    )
+
+    bs = 4
+    mgr = PagedBlockManager(64, bs, prefix_cache_enabled=True)
+    # two chains: a deep "hot path" (4 blocks) and a shallow one (2)
+    deep = list(range(100, 116))   # 16 tokens = 4 blocks
+    shallow = list(range(200, 208))  # 8 tokens = 2 blocks
+    for rid, tokens in (("deep", deep), ("shallow", shallow)):
+        assert mgr.grow_to(rid, len(tokens))
+        mgr.register_prefix(rid, tokens)
+        mgr.free(rid)
+    full = mgr.prefix_digest()
+    assert len(full) == 6
+    deep_hashes = prefix_block_hashes(deep, bs)
+    shallow_hashes = prefix_block_hashes(shallow, bs)
+    # every exported entry is usable: for any exported digest, its whole
+    # ancestor chain is in the export
+    for budget in (2, 3, 4, 5, 6):
+        out = set(mgr.prefix_digest(max_entries=budget))
+        assert len(out) <= budget
+        for chain in (deep_hashes, shallow_hashes):
+            for i, h in enumerate(chain):
+                if h in out:
+                    assert all(a in out for a in chain[: i + 1]), (
+                        budget, i, out,
+                    )
+    # budget 3 can't fit the 4-deep spine whole; it must still ship the
+    # complete 2-chain (plus at most an ancestor-closed PREFIX of the
+    # deep chain — a 1-block root spine is complete and usable), never
+    # a truncated frontier of deep leaves
+    out3 = set(mgr.prefix_digest(max_entries=3))
+    assert set(shallow_hashes) <= out3
+    deep_in = [h for h in deep_hashes if h in out3]
+    assert deep_in == deep_hashes[: len(deep_in)], (deep_in, out3)
+
+
+def test_delete_cascades_to_prefill_pool(disagg_handle):
+    """serve.delete of a disaggregated deployment must tear down the
+    paired prefill pool too — orphaned prefill replicas are full engines
+    (params + KV cache) that would otherwise survive until a
+    whole-controller shutdown. (Runs last: it deletes the module
+    fixture's deployment.)"""
+    assert "dllm-prefill" in serve.status()
+    serve.delete("dllm")
+    st = serve.status()
+    assert "dllm" not in st and "dllm-prefill" not in st, st

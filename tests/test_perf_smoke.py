@@ -347,3 +347,128 @@ def test_chunk_receive_path_zero_copy_guard():
         io.run(teardown())
         store.shutdown()
         io.stop()
+
+
+def test_kv_migration_raw_path_floor_and_receive_pool_reuse():
+    """KV-migration tripwires (ISSUE 13), cluster-free over the REAL
+    pull path: a migration-shaped payload pulled through PullManager
+    must (a) ride the RAW zero-copy receive for EVERY chunk (the
+    copy-count tripwire extended to the migration path), (b) clear a
+    deliberately generous throughput floor — kv_migration_gbps ~0.1+
+    GB/s warm on this box over loopback, floored at 0.02 so only an
+    order-of-magnitude regression (per-chunk bytes copies, RAW fallback,
+    digest recompute per chunk) trips it — and (c) REUSE the receive
+    segment across back-to-back migrations via the daemon-side pool
+    (delete with recycle_receive → allocate_receive pool hit), the
+    4.4-kernel substitute for MADV_POPULATE."""
+    import zlib
+
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.core.ids import JobID, ObjectID, TaskID
+    from ray_tpu.core.object_store import ShmStore
+    from ray_tpu.core.pull_manager import PullManager
+    from ray_tpu.core.rpc import IoThread, RawPayload, RpcClient, RpcServer
+    from ray_tpu.observability.rpc_metrics import PULL_CHUNKS, PULL_RAW_CHUNKS
+
+    payload_mb = 8
+    chunk_bytes = 1024 * 1024
+    payloads = {
+        i: bytes(bytearray((i + j) & 0xFF for j in range(256)) * (payload_mb * 4096))
+        for i in (1, 2)
+    }
+    oids = {
+        i: ObjectID.for_put(TaskID.for_driver(JobID.from_index(13)), i)
+        for i in (1, 2)
+    }
+    by_oid = {oids[i].binary(): payloads[i] for i in (1, 2)}
+
+    io = IoThread("kvmig-io")
+    old = (
+        GLOBAL_CONFIG.object_transfer_chunk_bytes,
+        GLOBAL_CONFIG.receive_segment_pool_bytes,
+    )
+    GLOBAL_CONFIG.object_transfer_chunk_bytes = chunk_bytes
+    GLOBAL_CONFIG.receive_segment_pool_bytes = 64 * 1024 * 1024
+    store = ShmStore(capacity_bytes=8 * payload_mb * 1024 * 1024)
+    clients = {}
+
+    def peer(host, port):
+        key = (host, port)
+        if key not in clients:
+            clients[key] = RpcClient(host, port, name="kvmig", role="noded")
+        return clients[key]
+
+    async def setup():
+        server = RpcServer()
+
+        async def object_info(p, conn):
+            data = by_oid[p["object_id"]]
+            return {"size": len(data), "digest": zlib.crc32(data)}
+
+        async def fetch_chunk(p, conn):
+            data = by_oid[p["object_id"]]
+            view = memoryview(data)[p["offset"] : p["offset"] + p["length"]]
+            assert p.get("raw"), "migration receiver stopped requesting RAW"
+            return RawPayload(view, meta=zlib.crc32(view))
+
+        server.register("object_info", object_info)
+        server.register("fetch_chunk", fetch_chunk)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    pm = PullManager(store, peer)
+    try:
+        raw_before = sum(PULL_RAW_CHUNKS._values.values())  # noqa: SLF001
+        total_before = sum(PULL_CHUNKS._values.values())  # noqa: SLF001
+
+        t0 = time.perf_counter()
+        reply = io.run(pm.pull(oids[1], [("127.0.0.1", port)]), timeout=120)
+        dt1 = time.perf_counter() - t0
+        assert reply.get("segment"), reply
+        assert store.read_bytes(oids[1]) == payloads[1]
+
+        # every migrated chunk rode the zero-copy receive
+        n_chunks = payload_mb
+        raw = sum(PULL_RAW_CHUNKS._values.values()) - raw_before  # noqa: SLF001
+        total = sum(PULL_CHUNKS._values.values()) - total_before  # noqa: SLF001
+        assert total == n_chunks and raw == n_chunks, (raw, total, n_chunks)
+
+        # the importer's delete recycles the segment into the pool …
+        assert store.delete(oids[1], recycle_receive=True) is True
+        assert store.stats()["recv_pool_segments"] == 1, store.stats()
+
+        # … and the NEXT migration reuses it instead of create+zero
+        t0 = time.perf_counter()
+        reply = io.run(pm.pull(oids[2], [("127.0.0.1", port)]), timeout=120)
+        dt2 = time.perf_counter() - t0
+        assert reply.get("segment"), reply
+        assert store.read_bytes(oids[2]) == payloads[2]
+        assert store.stats()["recv_pool_hits"] == 1, store.stats()
+
+        gbps = (2 * payload_mb / 1024) / (dt1 + dt2)
+        if gbps < 0.02:  # load-aware re-judge (the _floored_rate shape)
+            samples = [gbps]
+            for _ in range(2):
+                store.delete(oids[2], recycle_receive=True)
+                t0 = time.perf_counter()
+                io.run(pm.pull(oids[2], [("127.0.0.1", port)]), timeout=120)
+                samples.append(
+                    (payload_mb / 1024) / (time.perf_counter() - t0)
+                )
+            gbps = sorted(samples)[1]
+        assert gbps >= 0.02, f"kv_migration_gbps collapsed: {gbps:.3f} GB/s"
+    finally:
+        (
+            GLOBAL_CONFIG.object_transfer_chunk_bytes,
+            GLOBAL_CONFIG.receive_segment_pool_bytes,
+        ) = old
+
+        async def teardown():
+            for c in clients.values():
+                await c.close()
+            await server.stop()
+
+        io.run(teardown())
+        store.shutdown()
+        io.stop()
