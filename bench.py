@@ -728,7 +728,12 @@ def bench_tpu(results: Dict[str, Dict]) -> None:
     # jax.nn.dot_product_attention — a tuned path a user would actually
     # reach for — NOT the naive O(S^2)-materializing oracle (which HBM-
     # thrashes at long context and would flatter the kernel).
-    from ray_tpu.ops.attention import flash_attention
+    from ray_tpu.ops.attention import (
+        _pick_block,
+        default_blocks,
+        default_bwd_blocks,
+        flash_attention,
+    )
 
     def xla_dpa(q, k, v):
         # our layout is (b, h, s, d); jax.nn wants (b, s, h, d)
@@ -758,7 +763,10 @@ def bench_tpu(results: Dict[str, Dict]) -> None:
 
         # fwd+bwd: grad of sum(flash) = 2 fwd + 5 bwd matmuls = 3.5x fwd
         # flops. Grad wrt ALL inputs — q-only would let jit DCE the whole
-        # dk/dv kernel and inflate the number ~1.4x.
+        # dk/dv kernel and inflate the number ~1.4x. The backward runs
+        # its per-bucket tuned blocks (``default_bwd_blocks``), no longer
+        # the forward-shaped ones — the choice is emitted alongside the
+        # MFU so real-chip sweeps can re-anchor the bucket table.
         def fa_grad(q, k, v):
             dq, dk, dv = jax.grad(
                 lambda q, k, v: jnp.sum(fa(q, k, v).astype(jnp.float32)),
@@ -770,7 +778,17 @@ def bench_tpu(results: Dict[str, Dict]) -> None:
         dt = _bench_chained(fa_grad, q, k, v, iters=iters)
         tf = round(3.5 * flops / dt / 1e12, 2)
         results[f"flash_fwdbwd_s{s}_tflops"] = _maybe_invalid(
-            {"value": tf, "unit": "TFLOP/s", "mfu": mfu(tf)}, dt
+            {
+                "value": tf,
+                "unit": "TFLOP/s",
+                "mfu": mfu(tf),
+                # _pick_block-RESOLVED choices — the table entry clamps
+                # to a divisor of s, and re-anchoring the bucket table
+                # must attribute the MFU to the blocks that actually ran
+                "fwd_blocks": [_pick_block(s, w) for w in default_blocks(s)],
+                "bwd_blocks": [_pick_block(s, w) for w in default_bwd_blocks(s)],
+            },
+            dt,
         )
         print(f"  flash_fwdbwd_s{s}: {results[f'flash_fwdbwd_s{s}_tflops']}", file=sys.stderr, flush=True)
 
@@ -800,33 +818,57 @@ def bench_tpu(results: Dict[str, Dict]) -> None:
     )
     print(f"  cnn_forward_images_per_s: {results['cnn_forward_images_per_s']}", file=sys.stderr, flush=True)
 
-    # Llama train step on one chip: the largest config that comfortably
-    # fits a single chip's HBM (so remat/donation/layout decisions are
-    # actually exercised), with MFU against the chip peak.
+    # Llama train step — the UNIFIED named-sharding step (ISSUE 14): the
+    # same ``rules``-driven constrained step the multichip dryrun gates,
+    # run over every local device (fsdp over all chips; a 1-device box
+    # degenerates to the single-chip step with the constraints compiled
+    # in). Selective remat on TPU: save dots + flash outputs, recompute
+    # only the elementwise tail — the fwd+bwd roofline config.
     import optax
 
-    from ray_tpu.models.llama import LlamaConfig, init_params, make_train_step
+    from ray_tpu.models.llama import (
+        LlamaConfig,
+        batch_sharding,
+        init_sharded,
+        make_train_step,
+        param_count,
+    )
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.parallel.sharding import ddp_rules, fsdp_rules
 
     if on_tpu:
         cfg = LlamaConfig(
             vocab_size=32000, dim=1024, n_layers=24, n_heads=16, n_kv_heads=16,
             mlp_hidden=4096, max_seq_len=2048, dtype=jnp.bfloat16,
         )
-        batch, seq, remat = 8, 2048, True
+        batch, seq, remat = 8, 2048, "selective"
     else:
         cfg = LlamaConfig(
             vocab_size=8192, dim=512, n_layers=8, n_heads=8, n_kv_heads=8,
             mlp_hidden=1536, max_seq_len=1024, dtype=jnp.float32,
         )
         batch, seq, remat = 2, 256, False
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
-    results["train_model_params"] = {"value": n_params, "unit": "params"}
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshSpec(fsdp=n_dev), jax.devices())
+    rules = fsdp_rules() if n_dev > 1 else ddp_rules()
     opt = optax.adamw(1e-3)
-    opt_state = jax.jit(opt.init)(params)
-    step = make_train_step(cfg, opt, remat=remat, donate=True)
+    params, opt_state = init_sharded(cfg, mesh, rules, jax.random.PRNGKey(0), opt)
+    n_params = param_count(cfg)
+    results["train_model_params"] = {"value": n_params, "unit": "params"}
+    results["train_step_config"] = {
+        "value": "unified-sharding",
+        "devices": n_dev,
+        "rules": "fsdp" if n_dev > 1 else "ddp",
+        "remat": str(remat),
+        "unit": "",
+    }
+    step = make_train_step(cfg, opt, remat=remat, donate=True, mesh=mesh, rules=rules)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size, jnp.int32)
-    bd = {"tokens": tokens, "targets": tokens}
+    bs = batch_sharding(mesh, rules)
+    bd = {
+        "tokens": jax.device_put(tokens, bs),
+        "targets": jax.device_put(tokens, bs),
+    }
     state = (params, opt_state)
     state, loss = step(state, bd)  # compile
     float(loss)  # host readback: block_until_ready is unreliable on the tunnel
@@ -848,11 +890,15 @@ def bench_tpu(results: Dict[str, Dict]) -> None:
         return
     dt = (t2 - t1) / 10
     tok_s = batch * seq / dt
-    # standard 6ND accounting (fwd+bwd; remat recompute not credited)
+    # standard 6ND accounting (fwd+bwd; remat recompute not credited);
+    # MFU divides by the peak of EVERY device the mesh spans
     train_tflops = 6.0 * n_params * tok_s / 1e12
     results["train_tokens_per_s"] = {"value": round(tok_s, 1), "unit": "tokens/s"}
     results["train_tflops"] = {"value": round(train_tflops, 2), "unit": "TFLOP/s"}
-    results["train_mfu"] = {"value": mfu(train_tflops), "unit": "fraction of chip peak"}
+    results["train_mfu"] = {
+        "value": mfu(train_tflops / n_dev),
+        "unit": f"fraction of {n_dev}-chip peak",
+    }
     for k in ("train_tokens_per_s", "train_tflops", "train_mfu"):
         print(f"  {k}: {results[k]}", file=sys.stderr, flush=True)
 

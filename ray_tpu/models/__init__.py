@@ -15,6 +15,7 @@ from ray_tpu.models.llama import (
     next_token_loss,
     param_count,
     param_shardings,
+    partition_rules,
 )
 
 __all__ = [
@@ -27,4 +28,5 @@ __all__ = [
     "next_token_loss",
     "param_count",
     "param_shardings",
+    "partition_rules",
 ]

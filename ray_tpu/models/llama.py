@@ -24,8 +24,10 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops.attention import flash_attention
+from ray_tpu.parallel.sharding import constrain
 
 
 @dataclass(frozen=True)
@@ -141,6 +143,15 @@ def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
 
 
 def init_params(cfg: LlamaConfig, rng: jax.Array) -> Dict[str, Any]:
+    # partitionable threefry, same as init_sharded: the legacy lowering
+    # produces different values once XLA spatially partitions the RNG,
+    # so this is the only mode where the single-chip reference and the
+    # sharded init agree for the same seed (see init_sharded's docstring)
+    with jax.threefry_partitionable(True):
+        return _init_params(cfg, rng)
+
+
+def _init_params(cfg: LlamaConfig, rng: jax.Array) -> Dict[str, Any]:
     keys = jax.random.split(rng, cfg.n_layers + 2)
 
     def dense(key, shape, fan_in):
@@ -173,6 +184,43 @@ def init_params(cfg: LlamaConfig, rng: jax.Array) -> Dict[str, Any]:
         "final_norm": jnp.ones((cfg.dim,), cfg.dtype),
         "lm_head": dense(keys[-1], (cfg.dim, cfg.vocab_size), cfg.dim),
     }
+
+
+def partition_rules(cfg: LlamaConfig, rules) -> list:
+    """Ordered ``(regex, PartitionSpec)`` pairs covering every llama
+    param — the regex-rule source of truth ``match_partition_rules``
+    applies to params, grads, AND optimizer state (optax mu/nu mirror the
+    param tree, so the same path suffixes match; scalar leaves like
+    adam's ``count`` are skipped by the matcher). Specs derive from the
+    ``ShardingRules`` table, so swapping ddp/fsdp/tp re-derives the whole
+    set. Overrides go in FRONT (first ``re.search`` hit wins)."""
+    sp = rules.spec
+    out = [
+        # factored second-moment stats (adafactor v_row/v_col) are
+        # rank-REDUCED mirrors named after their param — the param's spec
+        # cannot apply (and after trailing-None stripping it may even
+        # have the right length for the wrong dims), so pin them
+        # replicated by NAME, in front of the param rules
+        (r"(^|/)v_(row|col)(/|$)", sp((None,))),
+        (r"(^|/)embed$", sp(("vocab", "embed"))),
+        (r"(attn_norm|mlp_norm|final_norm)$", sp((None,))),
+        (r"wq$", sp(("embed", "heads", "head_dim"))),
+        (r"(wk|wv)$", sp(("embed", "kv_heads", "head_dim"))),
+        (r"wo$", sp(("heads", "head_dim", "embed"))),
+        (r"lm_head$", sp(("embed", "vocab"))),
+    ]
+    if cfg.moe_experts > 0:
+        out += [
+            (r"router$", sp((None, None))),
+            (r"(w_gate|w_up)$", sp(("expert", "embed", "mlp"))),
+            (r"w_down$", sp(("expert", "mlp", "embed"))),
+        ]
+    else:
+        out += [
+            (r"(w_gate|w_up)$", sp(("embed", "mlp"))),
+            (r"w_down$", sp(("mlp", "embed"))),
+        ]
+    return out
 
 
 def param_count(cfg: LlamaConfig) -> int:
@@ -213,12 +261,19 @@ def apply_rope(x, cos, sin):
     return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
-def _attention_block(cfg: LlamaConfig, p, x, cos, sin, mesh=None):
+def _attention_block(cfg: LlamaConfig, p, x, cos, sin, mesh=None, rules=None):
     B, S, _ = x.shape
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
+    # attention ENTRY pin: q/k/v leave the projection in the head-sharded
+    # layout the attention impl expects (ring attention's shard_map specs
+    # are exactly these) — without it GSPMD picks per-op and the bwd
+    # disagrees with the fwd across the remat boundary
+    q = constrain(q, mesh, rules, ("act_batch", "act_seq", "act_heads", None))
+    k = constrain(k, mesh, rules, ("act_batch", "act_seq", "act_kv_heads", None))
+    v = constrain(v, mesh, rules, ("act_batch", "act_seq", "act_kv_heads", None))
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     rep = cfg.n_heads // cfg.n_kv_heads
@@ -254,59 +309,120 @@ def _attention_block(cfg: LlamaConfig, p, x, cos, sin, mesh=None):
         qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         o = flash_attention(qt, kt, vt, causal=True, impl=cfg.attention_impl)
     o = o.transpose(0, 2, 1, 3)  # [B, S, H, hd]
-    return x + jnp.einsum("bshk,hkd->bsd", o.astype(x.dtype), p["wo"])
+    # attention EXIT pin + name: the flash output is the expensive tensor
+    # the selective-remat policy saves (recompute elementwise, never the
+    # attention itself)
+    o = constrain(o, mesh, rules, ("act_batch", "act_seq", "act_heads", None))
+    o = checkpoint_name(o, "flash_attn_out")
+    out = x + jnp.einsum("bshk,hkd->bsd", o.astype(x.dtype), p["wo"])
+    return constrain(out, mesh, rules, ("act_batch", "act_seq", "act_embed"))
 
 
-def _mlp_block(cfg: LlamaConfig, p, x):
+def _mlp_block(cfg: LlamaConfig, p, x, mesh=None, rules=None):
     """Dense or MoE FFN. Returns (x, aux_loss)."""
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
     if cfg.moe_experts > 0:
         from ray_tpu.ops.moe import moe_ffn
 
+        # entry/exit pins bracket the expert compute (interior shardings
+        # over the ``expert`` axis are moe_ffn's own business) so the
+        # MoE FFN keeps the same replicated-residual contract as the
+        # dense branch and fwd/bwd agree across the remat boundary
+        h = constrain(h, mesh, rules, ("act_batch", "act_seq", "act_embed"))
         out, aux = moe_ffn(
             {k: p[k] for k in ("router", "w_gate", "w_up", "w_down")},
             h,
             top_k=cfg.moe_top_k,
             capacity_factor=cfg.moe_capacity_factor,
         )
-        return x + out, aux["aux_loss"]
+        out = x + out
+        out = constrain(out, mesh, rules, ("act_batch", "act_seq", "act_embed"))
+        return out, aux["aux_loss"]
     gate = jnp.einsum("bsd,dm->bsm", h, p["w_gate"])
     up = jnp.einsum("bsd,dm->bsm", h, p["w_up"])
-    return x + jnp.einsum("bsm,md->bsd", jax.nn.silu(gate) * up, p["w_down"]), 0.0
+    # Megatron split: the hidden activation shards over tensor, the
+    # down-projection's output all-reduces back to the replicated stream
+    gate = constrain(gate, mesh, rules, ("act_batch", "act_seq", "act_mlp"))
+    up = constrain(up, mesh, rules, ("act_batch", "act_seq", "act_mlp"))
+    out = x + jnp.einsum("bsm,md->bsd", jax.nn.silu(gate) * up, p["w_down"])
+    return constrain(out, mesh, rules, ("act_batch", "act_seq", "act_embed")), 0.0
 
 
-def forward(cfg: LlamaConfig, params, tokens, *, remat: bool = False, mesh=None,
-            return_aux: bool = False):
+def _remat_policy(remat):
+    """``remat``: False (no checkpointing), True/"full" (recompute
+    everything — the pre-unified default), or "selective" (save matmul
+    outputs and the flash-attention output, recompute only the cheap
+    elementwise tail: norms, rope, silu, residual adds). Selective remat
+    trades a little memory for skipping the expensive recompute — on the
+    stable shardings it is what closes the fwd-vs-fwd+bwd MFU cliff."""
+    if remat in (False, None):
+        return None, False
+    if remat is True or remat == "full":
+        return None, True
+    if remat == "selective":
+        pol = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("flash_attn_out"),
+        )
+        return pol, True
+    raise ValueError(f"remat must be False, True, 'full', or 'selective'; got {remat!r}")
+
+
+def forward(cfg: LlamaConfig, params, tokens, *, remat=False, mesh=None,
+            rules=None, return_aux: bool = False):
     """tokens [B, S] int32 → logits [B, S, vocab] (f32).
 
     ``mesh`` is required for the sequence-parallel attention impls
     ("ring"/"ulysses"), which shard_map over its ``seq`` axis. With
-    ``return_aux`` also returns the summed MoE load-balance loss."""
+    ``rules`` (a ``ShardingRules``) AND a mesh, every intermediate is
+    pinned via ``with_sharding_constraint`` so fwd and bwd agree on one
+    sharding per tensor (the multichip involuntary-remat fix); without
+    them the function is bit-identical to the unconstrained reference.
+    ``remat``: False | True/"full" | "selective" (see ``_remat_policy``).
+    With ``return_aux`` also returns the summed MoE load-balance loss."""
     B, S = tokens.shape
-    x = params["embed"][tokens]
+    # Embedding lookup: gathering from a vocab/embed-sharded table leaves
+    # the output embed-dim-sharded, and SPMD cannot reshard D-over-fsdp →
+    # batch-over-fsdp without a full rematerialization (the exact
+    # involuntary-remat warning MULTICHIP_r05 logged). Pin the table
+    # REPLICATED for the lookup instead — the all-gather becomes
+    # voluntary (ZeRO-3 semantics: params materialize for compute) and
+    # the batch/seq constraint on the output is a cheap slice.
+    emb = constrain(params["embed"], mesh, rules, (None, None))
+    x = emb[tokens]
+    x = constrain(x, mesh, rules, ("act_batch", "act_seq", "act_embed"))
     cos, sin = rope_tables(cfg, S)
 
     def block(carry, p):
         x, aux = carry
-        x = _attention_block(cfg, p, x, cos, sin, mesh=mesh)
-        x, layer_aux = _mlp_block(cfg, p, x)
+        # remat-boundary pin: the carry is the tensor saved at every
+        # checkpoint boundary — its fwd sharding must be explicit so the
+        # recompute and the bwd accumulation land on the same layout
+        x = constrain(x, mesh, rules, ("act_batch", "act_seq", "act_embed"))
+        x = _attention_block(cfg, p, x, cos, sin, mesh=mesh, rules=rules)
+        x, layer_aux = _mlp_block(cfg, p, x, mesh=mesh, rules=rules)
         return x, aux + layer_aux
 
-    if remat:
-        block = jax.checkpoint(block)
+    policy, do_remat = _remat_policy(remat)
+    if do_remat:
+        block = jax.checkpoint(block, policy=policy)
     carry = (x, jnp.zeros((), jnp.float32))
     for p in params["layers"]:
         carry = block(carry, p)
     x, aux = carry
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    logits = constrain(logits, mesh, rules, ("act_batch", "act_seq", "act_vocab"))
     if return_aux:
         return logits, aux
     return logits
 
 
-def next_token_loss(cfg: LlamaConfig, params, tokens, targets, *, remat: bool = False, mesh=None):
-    logits, aux = forward(cfg, params, tokens, remat=remat, mesh=mesh, return_aux=True)
+def next_token_loss(cfg: LlamaConfig, params, tokens, targets, *, remat=False,
+                    mesh=None, rules=None):
+    logits, aux = forward(
+        cfg, params, tokens, remat=remat, mesh=mesh, rules=rules, return_aux=True
+    )
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)
     return nll.mean() + cfg.moe_aux_loss_coeff * aux
@@ -335,12 +451,42 @@ def batch_sharding(mesh, rules):
 def init_sharded(cfg: LlamaConfig, mesh, rules, rng, optimizer=None):
     """Init params (and optimizer state) directly onto the mesh: the init
     computation is jitted with explicit out_shardings so no host has to
-    hold a full replica (how 7B+ params fit a v4-32 host)."""
+    hold a full replica (how 7B+ params fit a v4-32 host).
+
+    Runs under partitionable threefry: the legacy (non-partitionable)
+    RNG lowering produces DIFFERENT values when XLA spatially partitions
+    it, so the same seed gave different params per rules table — sharded
+    init silently diverged from the single-chip reference (measured
+    max-abs 0.6 on the tiny config). Partitionable threefry is
+    sharding-invariant, so init values match the unsharded path exactly
+    whatever the mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ray_tpu.parallel.sharding import match_partition_rules
+
     shardings = param_shardings(cfg, mesh, rules)
-    params = jax.jit(partial(init_params, cfg), out_shardings=shardings)(rng)
+    with jax.threefry_partitionable(True):
+        params = jax.jit(partial(init_params, cfg), out_shardings=shardings)(rng)
     if optimizer is None:
         return params
-    opt_state = jax.jit(optimizer.init)(params)
+    # Optimizer state inits pinned to the SAME matched rule table the
+    # train step constrains it to (mu/nu mirror the params; adam's count
+    # stays replicated). Without explicit out_shardings the jitted init
+    # hands back single-device state, and the step's first call would
+    # emit rule-sharded state — a guaranteed one-step recompile (and on
+    # real HBM, a full unsharded optimizer replica). partial() gives
+    # THIS call its own jit identity: callers reuse one optax optimizer
+    # across meshes (the multichip dryrun inits on two), and a bare
+    # ``optimizer.init`` would share one C++ jit cache across them — the
+    # PR 6 ``copy_paged_blocks`` cache-pollution class.
+    abstract = jax.eval_shape(optimizer.init, params)
+    ospecs = match_partition_rules(partition_rules(cfg, rules), abstract)
+    oshard = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        ospecs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+    opt_state = jax.jit(partial(optimizer.init), out_shardings=oshard)(params)
     return params, opt_state
 
 
@@ -544,25 +690,50 @@ def paged_decode_step(
     return cache, jnp.einsum("bd,dv->bv", x, params["lm_head"]).astype(jnp.float32)
 
 
-def make_train_step(cfg: LlamaConfig, optimizer, *, remat: bool = False, donate: bool = True, mesh=None):
+def make_train_step(cfg: LlamaConfig, optimizer, *, remat=False, donate: bool = True,
+                    mesh=None, rules=None):
     """Returns jitted ``step((params, opt_state), batch) → (state, loss)``.
 
     Gradient reduction over data/fsdp axes is inserted by GSPMD from the
     input shardings — there is no hand-written psum (scaling-book recipe:
-    annotate, compile, let XLA place collectives on ICI). ``mesh`` is
-    needed only for the sequence-parallel attention impls.
-    """
+    annotate, compile, let XLA place collectives on ICI).
+
+    With ``rules`` (a ``ShardingRules``) and ``mesh``, the UNIFIED
+    named-sharding path engages: params, grads, optimizer updates, and
+    optimizer state are all pinned to the ONE spec table
+    (``partition_rules`` + ``match_partition_rules``), and the forward
+    pins its intermediates — fwd, bwd, and the optimizer update agree on
+    every tensor, so the multichip compile has zero involuntary
+    rematerializations. Without ``rules`` the step is the legacy
+    unconstrained one (``mesh`` alone is still needed for the
+    sequence-parallel attention impls). ``remat``: False | True/"full" |
+    "selective" (save dots + flash outputs, recompute the elementwise
+    tail)."""
     import optax
+
+    from ray_tpu.parallel.sharding import constrain_tree
+
+    prules = partition_rules(cfg, rules) if rules is not None else None
+    act = rules if mesh is not None else None
 
     def step(state, batch):
         params, opt_state = state
+        params = constrain_tree(params, mesh, prules)
+        tokens = constrain(batch["tokens"], mesh, act, ("act_batch", "act_seq"))
+        targets = constrain(batch["targets"], mesh, act, ("act_batch", "act_seq"))
         loss, grads = jax.value_and_grad(
             lambda p: next_token_loss(
-                cfg, p, batch["tokens"], batch["targets"], remat=remat, mesh=mesh
+                cfg, p, tokens, targets, remat=remat, mesh=mesh, rules=act
             )
         )(params)
+        # grad → optimizer handoff: grads carry the params' specs (one
+        # table), so adamw's elementwise update never repartitions
+        grads = constrain_tree(grads, mesh, prules)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        updates = constrain_tree(updates, mesh, prules)
+        opt_state = constrain_tree(opt_state, mesh, prules)
         params = optax.apply_updates(params, updates)
+        params = constrain_tree(params, mesh, prules)
         return (params, opt_state), loss
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())

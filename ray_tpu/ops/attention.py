@@ -46,11 +46,39 @@ def _tpu_compiler_params(pltpu):
 
 
 def default_blocks(seq_q: int) -> tuple:
-    """Tuned on v5e (round-5 sweep, fwd+bwd which is what training
-    runs): (512, 1024) wins at s=2048 (67 vs 57 TFLOP/s) AND s=8192
-    (61 vs 56). Forward-only favors (1024, 512) at long seq by ~10%,
-    but a split default would desync the custom_vjp's fwd/bwd blocks."""
+    """FORWARD blocks, tuned on v5e (round-5 sweep): (512, 1024) wins at
+    s=2048 (67 vs 57 TFLOP/s) AND s=8192 (61 vs 56). The backward has its
+    own per-bucket table (``default_bwd_blocks``) — the custom_vjp
+    threads them independently, so the fwd no longer has to run
+    bwd-shaped blocks or vice versa."""
     return (512, 1024)
+
+
+#: Backward blocks per sequence bucket: seq_q upper bound → (block_q,
+#: block_k). The backward keeps ~3x the forward's VMEM live per tile
+#: (dq/dk+dv fp32 accumulators plus q, k, v, do tiles and the lse/delta
+#: rows), and the dkv pass streams Q tiles innermost — so the backward
+#: wants SMALLER q tiles than the forward to keep double-buffering room,
+#: while big K tiles keep the MXU fed. Running forward-shaped blocks in
+#: the backward is where the r05 51% (fwd) → 28-34% (fwd+bwd) MFU cliff
+#: lived. Table seeded from the v5e VMEM model; bench.py emits the
+#: per-bucket choice + measured fwd+bwd MFU so real-chip sweeps can
+#: re-anchor it.
+BWD_BLOCK_BUCKETS = (
+    (1024, (256, 512)),
+    (2048, (256, 1024)),
+    (4096, (256, 1024)),
+)
+#: fallback for sequences above the largest bucket
+_BWD_BLOCKS_LONG = (128, 1024)
+
+
+def default_bwd_blocks(seq_q: int) -> tuple:
+    """Backward (block_q, block_k) for this sequence bucket."""
+    for bound, blocks in BWD_BLOCK_BUCKETS:
+        if seq_q <= bound:
+            return blocks
+    return _BWD_BLOCKS_LONG
 
 
 def _pick_block(seq: int, want: int) -> Optional[int]:
@@ -433,19 +461,21 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, h, hk, res, g):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash3(q, k, v, causal, sm_scale, block_q, block_k, h, hk):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash3(q, k, v, causal, sm_scale, block_q, block_k, bwd_block_q, bwd_block_k, h, hk):
     o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, h, hk)
     return o
 
 
-def _flash3_fwd(q, k, v, causal, sm_scale, block_q, block_k, h, hk):
+def _flash3_fwd(q, k, v, causal, sm_scale, block_q, block_k, bwd_block_q, bwd_block_k, h, hk):
     o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, h, hk)
     return o, (q, k, v, o, lse)
 
 
-def _flash3_bwd(causal, sm_scale, block_q, block_k, h, hk, res, g):
-    return _flash_bwd(causal, sm_scale, block_q, block_k, h, hk, res, g)
+def _flash3_bwd(causal, sm_scale, block_q, block_k, bwd_block_q, bwd_block_k, h, hk, res, g):
+    # the backward runs ITS tuned blocks — the fwd blocks only shaped the
+    # saved residuals (q/k/v/o/lse are whole arrays, not tiles)
+    return _flash_bwd(causal, sm_scale, bwd_block_q, bwd_block_k, h, hk, res, g)
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
@@ -460,6 +490,8 @@ def flash_attention(
     sm_scale: Optional[float] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    block_q_bwd: Optional[int] = None,
+    block_k_bwd: Optional[int] = None,
     impl: str = "auto",
 ):
     """Multi-head attention. q: ``[batch, heads, seq, head_dim]``;
@@ -468,6 +500,11 @@ def flash_attention(
     mapping each q head's K/V block index onto its shared kv head, so
     repeated K/V never hits HBM (reference pattern: KV-repeat before
     torch SDPA; here the index map replaces the repeat).
+
+    ``block_q``/``block_k`` tile the FORWARD; ``block_q_bwd``/
+    ``block_k_bwd`` tile the backward independently (default: the
+    per-sequence-bucket table ``default_bwd_blocks`` — the backward's
+    VMEM/streaming profile wants different tiles than the forward).
 
     ``impl``: "pallas" (flash kernel), "xla" (reference), or "auto"
     (pallas on TPU, xla elsewhere — CI still covers the kernel through
@@ -489,9 +526,12 @@ def flash_attention(
 
     seq_k = k.shape[2]
     dbq, dbk = default_blocks(seq_q)
+    bbq, bbk = default_bwd_blocks(seq_q)
     block_q = _pick_block(seq_q, block_q or dbq)
     block_k = _pick_block(seq_k, block_k or dbk)
-    if block_q is None or block_k is None:
+    block_q_bwd = _pick_block(seq_q, block_q_bwd or bbq)
+    block_k_bwd = _pick_block(seq_k, block_k_bwd or bbk)
+    if None in (block_q, block_k, block_q_bwd, block_k_bwd):
         raise ValueError(
             f"sequence lengths ({seq_q}, {seq_k}) have no block divisor "
             f"≥128 — pad the sequence to a multiple of 128"
@@ -499,5 +539,8 @@ def flash_attention(
     qf = q.reshape(b * h, seq_q, d)
     kf = k.reshape(b * hk, seq_k, d)
     vf = v.reshape(b * hk, seq_k, d)
-    o = _flash3(qf, kf, vf, causal, sm_scale, block_q, block_k, h, hk)
+    o = _flash3(
+        qf, kf, vf, causal, sm_scale, block_q, block_k,
+        block_q_bwd, block_k_bwd, h, hk,
+    )
     return o.reshape(b, h, seq_q, d)

@@ -21,8 +21,16 @@ from ray_tpu.parallel.mesh import (
 )
 from ray_tpu.parallel.sharding import (
     ShardingRules,
+    constrain,
+    constrain_tree,
+    ddp_rules,
+    fsdp_rules,
     logical_to_sharding,
+    match_partition_rules,
+    named_tree_map,
     shard_params_fsdp,
+    tp_rules,
+    tree_path_names,
 )
 from ray_tpu.parallel.collectives import CollectiveGroup
 
@@ -39,7 +47,15 @@ __all__ = [
     "make_mesh",
     "cpu_mesh_devices",
     "ShardingRules",
+    "constrain",
+    "constrain_tree",
+    "ddp_rules",
+    "fsdp_rules",
     "logical_to_sharding",
+    "match_partition_rules",
+    "named_tree_map",
     "shard_params_fsdp",
+    "tp_rules",
+    "tree_path_names",
     "CollectiveGroup",
 ]

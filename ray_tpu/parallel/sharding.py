@@ -9,8 +9,9 @@ rules table re-parallelizes the whole model — no code changes.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from ray_tpu.parallel.mesh import DATA, EXPERT, FSDP, SEQUENCE, STAGE, TENSOR
 
@@ -27,10 +28,19 @@ class ShardingRules:
         return self.rules.get(logical)
 
     def spec(self, logical_axes: Sequence[Optional[str]]):
-        """PartitionSpec for an array annotated with logical axis names."""
+        """PartitionSpec for an array annotated with logical axis names.
+
+        Trailing ``None`` entries are stripped: ``P('fsdp', None)`` and
+        ``P('fsdp')`` mean the same sharding but hash as DIFFERENT jit
+        cache keys — a step fed table-built shardings would "recompile"
+        once when its own outputs (XLA-normalized, trailing Nones
+        dropped) came back as inputs."""
         from jax.sharding import PartitionSpec
 
-        return PartitionSpec(*[self.rules.get(a) if a else None for a in logical_axes])
+        entries = [self.rules.get(a) if a else None for a in logical_axes]
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
 
     def with_overrides(self, **updates: MeshAxes) -> "ShardingRules":
         merged = dict(self.rules)
@@ -53,13 +63,27 @@ def ddp_rules() -> ShardingRules:
             "head_dim": None,
             "vocab": None,
             "expert": None,
+            # activation axes (``act_*``): how INTERMEDIATE tensors shard,
+            # distinct from the parameter axes above — e.g. under tp the
+            # params' embed dim shards over fsdp (ZeRO-style storage) but
+            # the residual stream's embed dim stays replicated across
+            # tensor ranks. One table drives both so fwd, bwd, and the
+            # optimizer see ONE source of truth (the involuntary-remat
+            # fix: every with_sharding_constraint derives from here).
+            "act_batch": (DATA, FSDP),
+            "act_seq": None,
+            "act_embed": None,
+            "act_heads": None,
+            "act_kv_heads": None,
+            "act_mlp": None,
+            "act_vocab": None,
         }
     )
 
 
 def fsdp_rules() -> ShardingRules:
     """ZeRO-3 equivalent via GSPMD: params sharded on fsdp over their
-    embed dim; batch over (data, fsdp)."""
+    embed dim; batch over (data, fsdp); activations batch-sharded only."""
     return ShardingRules(
         {
             "batch": (DATA, FSDP),
@@ -71,6 +95,13 @@ def fsdp_rules() -> ShardingRules:
             "head_dim": None,
             "vocab": None,
             "expert": None,
+            "act_batch": (DATA, FSDP),
+            "act_seq": None,
+            "act_embed": None,
+            "act_heads": None,
+            "act_kv_heads": None,
+            "act_mlp": None,
+            "act_vocab": None,
         }
     )
 
@@ -78,7 +109,9 @@ def fsdp_rules() -> ShardingRules:
 def tp_rules() -> ShardingRules:
     """Megatron-style tensor parallel: mlp/heads/vocab over tensor;
     params' embed dim over fsdp; batch over (data, fsdp); sequence over
-    seq (ring attention)."""
+    seq (ring attention). Activations: heads/mlp-hidden/vocab shard over
+    tensor (the Megatron split), the residual stream stays replicated
+    across tensor ranks, sequence rides the seq axis."""
     return ShardingRules(
         {
             "batch": (DATA, FSDP),
@@ -90,6 +123,13 @@ def tp_rules() -> ShardingRules:
             "head_dim": None,
             "vocab": TENSOR,
             "expert": EXPERT,
+            "act_batch": (DATA, FSDP),
+            "act_seq": SEQUENCE,
+            "act_embed": None,
+            "act_heads": TENSOR,
+            "act_kv_heads": TENSOR,
+            "act_mlp": TENSOR,
+            "act_vocab": TENSOR,
         }
     )
 
@@ -98,6 +138,127 @@ def logical_to_sharding(mesh, rules: ShardingRules, logical_axes: Sequence[Optio
     from jax.sharding import NamedSharding
 
     return NamedSharding(mesh, rules.spec(logical_axes))
+
+
+# Regex partition rules ----------------------------------------------------
+#
+# The named-sharding source of truth for whole PYTREES: an ordered list of
+# ``(regex, PartitionSpec)`` pairs matched against each leaf's '/'-joined
+# tree path. One table covers params, grads (same tree), and optimizer
+# state (optax mu/nu mirror the param tree, so ``wq$`` matches
+# ``0/mu/layers/3/wq`` too; scalar leaves like adam's ``count`` are
+# skipped). This is what lets fwd, bwd, and the optimizer update agree on
+# every tensor's sharding — the multichip involuntary-remat fix.
+
+
+def tree_path_names(tree: Any, sep: str = "/") -> List[str]:
+    """'/'-joined key path for every leaf, in tree_leaves order."""
+    import jax
+
+    paths_and_leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [
+        sep.join(_path_entry_name(entry) for entry in path)
+        for path, _leaf in paths_and_leaves
+    ]
+
+
+def _path_entry_name(entry) -> str:
+    import jax
+
+    if isinstance(entry, jax.tree_util.DictKey):
+        return str(entry.key)
+    if isinstance(entry, jax.tree_util.SequenceKey):
+        return str(entry.idx)
+    if isinstance(entry, jax.tree_util.GetAttrKey):
+        return str(entry.name)
+    if isinstance(entry, jax.tree_util.FlattenedIndexKey):
+        return str(entry.key)
+    return str(entry)
+
+
+def named_tree_map(fn: Callable[[str, Any], Any], tree: Any, sep: str = "/") -> Any:
+    """``tree_map`` where ``fn(path_name, leaf)`` also sees the leaf's
+    '/'-joined key path (the SNIPPETS [1] pattern)."""
+    import jax
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: fn(
+            sep.join(_path_entry_name(entry) for entry in path), leaf
+        ),
+        tree,
+    )
+
+
+def match_partition_rules(rules, tree: Any):
+    """Pytree of ``PartitionSpec`` for ``tree`` from ordered regex rules.
+
+    ``rules``: sequence of ``(pattern, PartitionSpec)``; the FIRST
+    ``re.search`` hit wins, so overrides go in front. Scalar leaves
+    (0-d or single-element) are never partitioned — they map to ``PS()``
+    without consulting the rules (optax ``count``, loss scalars). A
+    non-scalar leaf with no matching rule raises: silent replication is
+    exactly how shardings drift apart across the step. A matched spec
+    LONGER than the leaf's rank means the leaf is a rank-reduced mirror
+    of the param the rule was written for (adafactor ``v_row``/``v_col``,
+    SM3 diagonals) — the param's spec is structurally inapplicable, so
+    those leaves replicate instead of raising. This length check is only
+    a backstop: trailing-None stripping can leave a param spec the same
+    length as a reduced stat's rank, so rules tables should ALSO pin
+    known factored stats by name, in front (see
+    ``models/llama.py::partition_rules``'s ``v_(row|col)`` rule).
+    """
+    import numpy as np
+    from jax.sharding import PartitionSpec
+
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def get_spec(name: str, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return PartitionSpec()
+        for pat, spec in compiled:
+            if pat.search(name) is not None:
+                if len(spec) > len(shape):
+                    return PartitionSpec()
+                return spec
+        raise ValueError(f"no partition rule matched leaf {name!r} {shape}")
+
+    return named_tree_map(get_spec, tree)
+
+
+def constrain(x: Any, mesh, rules: ShardingRules, logical_axes: Sequence[Optional[str]]):
+    """``with_sharding_constraint`` by LOGICAL axis names: pins an
+    intermediate's sharding to the rule table inside jit, so GSPMD never
+    has to guess (and never disagrees with itself across fwd/bwd). A
+    ``None`` mesh or rules is a no-op — single-device reference paths
+    stay constraint-free and bit-identical to before."""
+    if mesh is None or rules is None:
+        return x
+    import jax
+
+    return jax.lax.with_sharding_constraint(
+        x, logical_to_sharding(mesh, rules, logical_axes)
+    )
+
+
+def constrain_tree(tree: Any, mesh, rules) -> Any:
+    """Pin a whole pytree (params/grads/opt-state) to its matched specs.
+
+    ``rules``: ordered ``(regex, PartitionSpec)`` pairs (see
+    ``match_partition_rules``). No-op when mesh or rules is None."""
+    if mesh is None or rules is None:
+        return tree
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = match_partition_rules(rules, tree)
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec)
+        ),
+        tree,
+        specs,
+    )
 
 
 def shard_params_fsdp(mesh, params, min_size: int = 2**14):

@@ -20,6 +20,8 @@ from ray_tpu.train.session import (
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    get_mesh,
+    get_sharding_rules,
     report,
     urgent_checkpoint_requested,
 )
@@ -46,6 +48,8 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "get_mesh",
+    "get_sharding_rules",
     "report",
     "urgent_checkpoint_requested",
 ]

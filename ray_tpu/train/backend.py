@@ -16,6 +16,7 @@ import logging
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from ray_tpu.parallel.mesh import MeshSpec
 from ray_tpu.train.worker_group import WorkerGroup
 
 logger = logging.getLogger(__name__)
@@ -48,11 +49,23 @@ class JaxBackendConfig(BackendConfig):
 
     distributed=None → auto (initialize when num_workers > 1).
     platform: force ``JAX_PLATFORMS`` in workers (tests: ``"cpu"``).
-    """
+
+    mesh_spec/sharding: the gang's UNIFIED parallelism plan. When set,
+    every worker can call ``train.get_mesh()`` /
+    ``train.get_sharding_rules()`` after the rendezvous and receive the
+    SAME global mesh (built over all gang devices; ``-1`` axes resolve
+    against the global device count) and the same canonical rules table
+    ("ddp" | "fsdp" | "tp") — the one named-sharding source of truth the
+    constrained train step (``models/llama.py::make_train_step``)
+    derives every param/grad/optimizer-state spec from. Declaring the
+    plan HERE rather than in each worker loop is what guarantees all
+    ranks compile the identical pjit program (SPMD requires it)."""
 
     distributed: Optional[bool] = None
     platform: Optional[str] = None
     extra_env: Optional[Dict[str, str]] = None
+    mesh_spec: Optional[MeshSpec] = None
+    sharding: Optional[str] = None  # "ddp" | "fsdp" | "tp"
 
     def backend_cls(self):
         return JaxBackend

@@ -159,6 +159,42 @@ def get_checkpoint() -> Optional[Checkpoint]:
     return _get_session().context.checkpoint
 
 
+def get_mesh():
+    """The gang's global device mesh, built from the
+    ``JaxBackendConfig.mesh_spec`` the trainer declared — every rank
+    gets the SAME mesh over all gang devices (call AFTER the rendezvous,
+    i.e. anywhere inside the user loop; the backend setup_fn ran
+    ``jax.distributed.initialize`` before the loop started). ``-1`` axes
+    resolve against the global device count. Returns None when no
+    mesh_spec was configured."""
+    ctx = get_context()
+    spec_fields = ctx.metadata.get("mesh_spec")
+    if spec_fields is None:
+        return None
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    return make_mesh(MeshSpec(**spec_fields))
+
+
+def get_sharding_rules():
+    """The gang's canonical ``ShardingRules`` table (from
+    ``JaxBackendConfig.sharding``: "ddp" | "fsdp" | "tp") — pass it with
+    ``get_mesh()`` into ``models.llama.make_train_step(mesh=, rules=)``
+    for the unified constrained step. None when not configured."""
+    ctx = get_context()
+    name = ctx.metadata.get("sharding_rules")
+    if name is None:
+        return None
+    from ray_tpu.parallel import sharding as _sh
+
+    try:
+        return {"ddp": _sh.ddp_rules, "fsdp": _sh.fsdp_rules, "tp": _sh.tp_rules}[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown sharding rules table {name!r} (expected ddp|fsdp|tp)"
+        ) from None
+
+
 def urgent_checkpoint_requested() -> bool:
     """True when a preemption warning landed (a node hosting this gang is
     DRAINING): save a checkpoint with the next ``report()`` so the run

@@ -134,6 +134,19 @@ class JaxTrainer:
                 raise TrainingFailedError(f"worker group start failed: {e!r}") from e
             setup_fn = getattr(backend, "setup_fn", lambda: None)()
             name = self.run_config.name or os.path.basename(run_dir)
+            # unified parallelism plan (JaxBackendConfig.mesh_spec/
+            # sharding): declared ONCE on the trainer, delivered to every
+            # rank via context metadata so train.get_mesh()/
+            # get_sharding_rules() hand all workers the identical plan
+            shared_meta: Dict[str, Any] = {"datasets": list(self.datasets)}
+            mesh_spec = getattr(self.backend_config, "mesh_spec", None)
+            if mesh_spec is not None:
+                from dataclasses import asdict
+
+                shared_meta["mesh_spec"] = asdict(mesh_spec)
+            sharding = getattr(self.backend_config, "sharding", None)
+            if sharding is not None:
+                shared_meta["sharding_rules"] = sharding
             contexts = [
                 TrainContext(
                     world_size=n,
@@ -143,7 +156,7 @@ class JaxTrainer:
                     experiment_name=name,
                     trial_dir=run_dir,
                     checkpoint=resume,
-                    metadata={"datasets": list(self.datasets)},
+                    metadata=dict(shared_meta),
                 )
                 for rank in range(n)
             ]

@@ -238,6 +238,29 @@ multiprocess_cpu_collectives = pytest.mark.skipif(
 )
 
 
+def wait_for_node_resource(name, *, exclude=(), timeout=20.0):
+    """Block until an ALIVE node carrying resource ``name`` (and not in
+    ``exclude`` node-ids) is registered — the condition-based replacement
+    for the blind ``sleep(1.0)`` after ``cluster.add_node`` (suite-time
+    CAUTION: fixed sleeps were ~10s of pure waiting across the cluster
+    modules). Returns the node_id."""
+    import time as _time
+
+    import ray_tpu as _rt
+
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        for n in _rt.nodes():
+            if (
+                n.get("Alive")
+                and name in (n.get("Resources") or {})
+                and n.get("node_id") not in exclude
+            ):
+                return n["node_id"]
+        _time.sleep(0.05)
+    raise TimeoutError(f"no alive node with resource {name!r} within {timeout}s")
+
+
 # ---------------------------------------------------------------------------
 # per-test hard timeout (stdlib faulthandler, no plugin dependency)
 

@@ -1,5 +1,13 @@
 """Fault tolerance: node death detection + actor restart on a new node,
-drain-vs-crash restart accounting, and pool-actor recovery in Data."""
+drain-vs-crash restart accounting, and pool-actor recovery in Data.
+
+Suite-time note (ISSUE 14): one MODULE-scoped cluster instead of a full
+cluster per test. Each node-failure test adds its own sacrificial
+node(s) with test-unique resources, so a leftover replacement node from
+an earlier test can never host a later test's pinned actor. The drain
+grace is shortened for the WHOLE module (set before any daemon spawns so
+it serializes into them): a plain actor never exits on its own, and the
+drain would otherwise wait the full 30s before deregistering."""
 
 import os
 import signal
@@ -10,37 +18,50 @@ import pytest
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
+from conftest import wait_for_node_resource
 
-def test_node_death_actor_restart():
-    cluster = Cluster(num_cpus=1)
-    n2 = cluster.add_node(num_cpus=1, resources={"pin": 1})
-    time.sleep(1.0)
+
+@pytest.fixture(scope="module")
+def ft_cluster():
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    old_grace = GLOBAL_CONFIG.drain_grace_s
+    GLOBAL_CONFIG.drain_grace_s = 3.0
+    cluster = Cluster(num_cpus=4)
+    time.sleep(0.5)
     ray_tpu.init(address=cluster.address)
-    try:
+    yield cluster
+    GLOBAL_CONFIG.drain_grace_s = old_grace
+    ray_tpu.shutdown()
+    cluster.shutdown()
 
-        @ray_tpu.remote(max_restarts=1, resources={"pin": 1}, num_cpus=0)
-        class A:
-            def pid(self):
-                import os
 
-                return os.getpid()
+def test_node_death_actor_restart(ft_cluster):
+    cluster = ft_cluster
+    n2 = cluster.add_node(num_cpus=1, resources={"pin_nd": 1})
+    nid = wait_for_node_resource("pin_nd")
 
-        a = A.remote()
-        pid1 = ray_tpu.get(a.pid.remote(), timeout=120)
-        cluster.remove_node(n2)
-        cluster.add_node(num_cpus=1, resources={"pin": 1})
-        deadline = time.time() + 90
-        pid2 = None
-        while time.time() < deadline:
-            try:
-                pid2 = ray_tpu.get(a.pid.remote(), timeout=15)
-                break
-            except ray_tpu.RayTpuError:
-                time.sleep(1)
-        assert pid2 is not None and pid2 != pid1
-    finally:
-        ray_tpu.shutdown()
-        cluster.shutdown()
+    @ray_tpu.remote(max_restarts=1, resources={"pin_nd": 1}, num_cpus=0)
+    class A:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    a = A.remote()
+    pid1 = ray_tpu.get(a.pid.remote(), timeout=120)
+    cluster.remove_node(n2)
+    cluster.add_node(num_cpus=1, resources={"pin_nd": 1})
+    wait_for_node_resource("pin_nd", exclude={nid})
+    deadline = time.time() + 90
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(a.pid.remote(), timeout=15)
+            break
+        except ray_tpu.RayTpuError:
+            time.sleep(1)
+    assert pid2 is not None and pid2 != pid1
 
 
 def _num_restarts(handle) -> int:
@@ -53,68 +74,59 @@ def _num_restarts(handle) -> int:
     return info["num_restarts"]
 
 
-def test_drain_vs_crash_restart_accounting():
+def test_drain_vs_crash_restart_accounting(ft_cluster):
     """The SAME actor failover path, two causes: a node CRASH consumes
     max_restarts budget, a node DRAIN does not — preemption is not the
     actor's failure (reference: DrainNode restarts are budget-exempt)."""
-    from ray_tpu.core.config import GLOBAL_CONFIG
-
-    # short grace (see test_drain.py): a plain actor never exits on its
-    # own, so the drain otherwise waits the full 30s before deregistering
-    old_grace = GLOBAL_CONFIG.drain_grace_s
-    GLOBAL_CONFIG.drain_grace_s = 3.0
-    cluster = Cluster(num_cpus=1)
+    cluster = ft_cluster
     n_crash = cluster.add_node(num_cpus=1, resources={"crash": 1})
-    n_drain = cluster.add_node(num_cpus=1, resources={"drain": 1})
-    time.sleep(1.0)
-    ray_tpu.init(address=cluster.address)
-    try:
+    cluster.add_node(num_cpus=1, resources={"drain": 1})
+    crash_nid = wait_for_node_resource("crash")
+    drain_nid0 = wait_for_node_resource("drain")
 
-        @ray_tpu.remote(max_restarts=2, max_task_retries=4, num_cpus=0)
-        class A:
-            def pid(self):
-                return os.getpid()
+    @ray_tpu.remote(max_restarts=2, max_task_retries=4, num_cpus=0)
+    class A:
+        def pid(self):
+            return os.getpid()
 
-            def node(self):
-                return ray_tpu.get_runtime_context().get_node_id()
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
 
-        a_crash = A.options(resources={"crash": 1}).remote()
-        a_drain = A.options(resources={"drain": 1}).remote()
-        ray_tpu.get([a_crash.pid.remote(), a_drain.pid.remote()], timeout=120)
-        drain_nid = ray_tpu.get(a_drain.node.remote(), timeout=60)
-        # replacement capacity for both actors
-        cluster.add_node(num_cpus=2, resources={"crash": 1, "drain": 1})
-        time.sleep(1.0)
+    a_crash = A.options(resources={"crash": 1}).remote()
+    a_drain = A.options(resources={"drain": 1}).remote()
+    ray_tpu.get([a_crash.pid.remote(), a_drain.pid.remote()], timeout=120)
+    drain_nid = ray_tpu.get(a_drain.node.remote(), timeout=60)
+    # replacement capacity for both actors
+    cluster.add_node(num_cpus=2, resources={"crash": 1, "drain": 1})
+    wait_for_node_resource("crash", exclude={crash_nid})
+    wait_for_node_resource("drain", exclude={drain_nid0})
 
-        # crash path: hard node kill
-        cluster.remove_node(n_crash)
-        # drain path: graceful preemption
-        assert ray_tpu.drain_node(drain_nid, "test: drain-vs-crash")
+    # crash path: hard node kill
+    cluster.remove_node(n_crash)
+    # drain path: graceful preemption
+    assert ray_tpu.drain_node(drain_nid, "test: drain-vs-crash")
 
-        def recovered(handle):
-            deadline = time.time() + 90
-            while time.time() < deadline:
-                try:
-                    return ray_tpu.get(handle.pid.remote(), timeout=15)
-                except ray_tpu.RayTpuError:
-                    time.sleep(1)
-            return None
+    def recovered(handle):
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                return ray_tpu.get(handle.pid.remote(), timeout=15)
+            except ray_tpu.RayTpuError:
+                time.sleep(1)
+        return None
 
-        assert recovered(a_crash) is not None
-        assert recovered(a_drain) is not None
-        assert _num_restarts(a_crash) == 1  # crash consumed budget
-        assert _num_restarts(a_drain) == 0  # drain did not
-    finally:
-        GLOBAL_CONFIG.drain_grace_s = old_grace
-        ray_tpu.shutdown()
-        cluster.shutdown()
+    assert recovered(a_crash) is not None
+    assert recovered(a_drain) is not None
+    assert _num_restarts(a_crash) == 1  # crash consumed budget
+    assert _num_restarts(a_drain) == 0  # drain did not
 
 
-def test_data_pool_actor_death_recovery(shutdown_only):
+def test_data_pool_actor_death_recovery(ft_cluster):
     """A Data actor-pool stage survives its pool actors being SIGKILLed
     mid-block: in-flight blocks resubmit to surviving/fresh actors and
-    the stage completes with every block intact."""
-    ray_tpu.init(num_cpus=4)
+    the stage completes with every block intact. (Rides the module
+    cluster — the pool actors land wherever CPU is free; the SIGKILL is
+    same-host either way.)"""
     from ray_tpu.data.executor import (
         ActorPoolStrategy,
         ActorStage,

@@ -1,7 +1,15 @@
 """Lineage reconstruction: lost objects are rebuilt by resubmitting the
 producing task (reference ``object_recovery_manager.h:90``,
-``task_manager.h:273`` ResubmitTask)."""
+``task_manager.h:273`` ResubmitTask).
 
+Suite-time note (ISSUE 14): one MODULE-scoped head cluster instead of a
+full cluster per test (was ~77s for 5 tests, each paying head spawn +
+driver init + teardown). Every test still gets its own SACRIFICIAL node
+carrying a test-unique pin resource, so killing it provably loses that
+test's objects — leftover replacement nodes from earlier tests can never
+host a later test's pinned producer."""
+
+import itertools
 import time
 
 import numpy as np
@@ -10,66 +18,74 @@ import pytest
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
+from conftest import wait_for_node_resource
 
-def _make_cluster():
+_pin_ids = itertools.count()
+
+
+@pytest.fixture(scope="module")
+def lineage_cluster():
     cluster = Cluster(num_cpus=2)
-    n2 = cluster.add_node(num_cpus=2, resources={"pin": 2})
-    time.sleep(1.0)
+    time.sleep(0.5)
     ray_tpu.init(address=cluster.address)
-    return cluster, n2
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
 
 
-def test_get_recovers_lost_object():
+@pytest.fixture
+def pin(lineage_cluster):
+    """(cluster, pin_resource_name, node): a fresh sacrificial node whose
+    pin resource no other (leftover) node carries."""
+    name = f"pin{next(_pin_ids)}"
+    node = lineage_cluster.add_node(num_cpus=2, resources={name: 2})
+    nid = wait_for_node_resource(name)
+    return lineage_cluster, name, node, nid
+
+
+def test_get_recovers_lost_object(pin):
     """Produce a big (shm) object on node B, kill B, get() — the owner
     resubmits the producing task on a replacement node."""
-    cluster, n2 = _make_cluster()
-    try:
+    cluster, res, n2, nid = pin
 
-        @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
-        def produce():
-            return np.ones(1 << 20, dtype=np.uint8)  # 1 MiB -> shm path
+    @ray_tpu.remote(resources={res: 1}, num_cpus=0)
+    def produce():
+        return np.ones(1 << 20, dtype=np.uint8)  # 1 MiB -> shm path
 
-        ref = produce.remote()
-        # wait WITHOUT fetching: the only shm copy must stay on node B
-        ready, _ = ray_tpu.wait([ref], timeout=120, fetch_local=False)
-        assert ready
-        cluster.remove_node(n2)
-        cluster.add_node(num_cpus=2, resources={"pin": 2})
-        time.sleep(1.0)
-        out = ray_tpu.get(ref, timeout=120)  # triggers reconstruction
-        assert out.sum() == 1 << 20
-    finally:
-        ray_tpu.shutdown()
-        cluster.shutdown()
+    ref = produce.remote()
+    # wait WITHOUT fetching: the only shm copy must stay on node B
+    ready, _ = ray_tpu.wait([ref], timeout=120, fetch_local=False)
+    assert ready
+    cluster.remove_node(n2)
+    cluster.add_node(num_cpus=2, resources={res: 2})
+    wait_for_node_resource(res, exclude={nid})
+    out = ray_tpu.get(ref, timeout=120)  # triggers reconstruction
+    assert out.sum() == 1 << 20
 
 
-def test_borrower_task_recovers_lost_dependency():
+def test_borrower_task_recovers_lost_dependency(pin):
     """A task consuming a lost ref triggers owner-side reconstruction
     through the borrower fetch path (w_recover_object)."""
-    cluster, n2 = _make_cluster()
-    try:
+    cluster, res, n2, nid = pin
 
-        @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
-        def produce():
-            return np.full(1 << 20, 7, dtype=np.uint8)
+    @ray_tpu.remote(resources={res: 1}, num_cpus=0)
+    def produce():
+        return np.full(1 << 20, 7, dtype=np.uint8)
 
-        @ray_tpu.remote(num_cpus=1)
-        def consume(arr):
-            return int(arr[0]) + int(arr[-1])
+    @ray_tpu.remote(num_cpus=1)
+    def consume(arr):
+        return int(arr[0]) + int(arr[-1])
 
-        ref = produce.remote()
-        ready, _ = ray_tpu.wait([ref], timeout=120, fetch_local=False)
-        assert ready
-        cluster.remove_node(n2)
-        cluster.add_node(num_cpus=2, resources={"pin": 2})
-        time.sleep(1.0)
-        assert ray_tpu.get(consume.remote(ref), timeout=120) == 14
-    finally:
-        ray_tpu.shutdown()
-        cluster.shutdown()
+    ref = produce.remote()
+    ready, _ = ray_tpu.wait([ref], timeout=120, fetch_local=False)
+    assert ready
+    cluster.remove_node(n2)
+    cluster.add_node(num_cpus=2, resources={res: 2})
+    wait_for_node_resource(res, exclude={nid})
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 14
 
 
-def test_inline_results_across_node_loss_and_reconstruction():
+def test_inline_results_across_node_loss_and_reconstruction(pin):
     """Inline results cross the failure paths without reconstruction:
     (a) a small (inlined) result survives losing its producing node with
     retries exhausted — it lives in the OWNER's inline cache; (b) a
@@ -79,11 +95,11 @@ def test_inline_results_across_node_loss_and_reconstruction():
     to lineage reconstruction)."""
     import tempfile
 
-    cluster, n2 = _make_cluster()
+    cluster, res, n2, nid = pin
     marker = tempfile.mktemp(prefix="raytpu-inline-dep-")
     try:
 
-        @ray_tpu.remote(resources={"pin": 1}, num_cpus=0, max_retries=0)
+        @ray_tpu.remote(resources={res: 1}, num_cpus=0, max_retries=0)
         def small():
             return b"inline-payload" * 8  # far under the inline threshold
 
@@ -93,7 +109,7 @@ def test_inline_results_across_node_loss_and_reconstruction():
                 f.write(b"x")  # side-effect counter: one byte per run
             return 7
 
-        @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
+        @ray_tpu.remote(resources={res: 1}, num_cpus=0)
         def big_from(dep):
             return np.full(1 << 20, dep, dtype=np.uint8)
 
@@ -105,8 +121,8 @@ def test_inline_results_across_node_loss_and_reconstruction():
         )
         assert len(ready) == 2
         cluster.remove_node(n2)
-        cluster.add_node(num_cpus=2, resources={"pin": 2})
-        time.sleep(1.0)
+        cluster.add_node(num_cpus=2, resources={res: 2})
+        wait_for_node_resource(res, exclude={nid})
         # (a) inline result: max_retries=0, so only the owner's inline
         # copy can satisfy this — no reconstruction possible or needed
         assert ray_tpu.get(inline_ref, timeout=60) == b"inline-payload" * 8
@@ -123,57 +139,45 @@ def test_inline_results_across_node_loss_and_reconstruction():
             _os.unlink(marker)
         except OSError:
             pass
-        ray_tpu.shutdown()
-        cluster.shutdown()
 
 
-def test_put_object_loss_raises_object_lost():
+def test_put_object_loss_raises_object_lost(lineage_cluster):
     """put() objects have no lineage: losing every copy surfaces
-    ObjectLostError instead of hanging in a recovery loop."""
-    cluster, _n2 = _make_cluster()
+    ObjectLostError instead of hanging in a recovery loop. (put() stores
+    on the driver's local — head — daemon, so no pin node is needed.)"""
+    from ray_tpu.core.api import _global_worker
+
+    ref = ray_tpu.put(np.ones(1 << 20, dtype=np.uint8))
+    # Simulate losing the only shm copy: delete it from the head
+    # daemon's store behind the owner's back (the reference does the
+    # same with internal test hooks, ``_private/test_utils.py``).
+    core = _global_worker().backend
+    core.io.run(
+        core.daemon.call("delete_object", {"object_id": ref.id().binary()})
+    )
+    with pytest.raises(ray_tpu.ObjectLostError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_exhausted_reconstruction_attempts_raise(pin):
+    """A ref whose producing task is out of reconstruction attempts
+    surfaces ObjectLostError."""
+    cluster, res, n2, nid = pin
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    old = GLOBAL_CONFIG.max_lineage_reconstructions
+    GLOBAL_CONFIG.max_lineage_reconstructions = 0
     try:
-        import numpy as np
 
-        from ray_tpu.core.api import _global_worker
+        @ray_tpu.remote(resources={res: 1}, num_cpus=0)
+        def produce():
+            return np.ones(1 << 20, dtype=np.uint8)
 
-        ref = ray_tpu.put(np.ones(1 << 20, dtype=np.uint8))
-        # Simulate losing the only shm copy: delete it from the head
-        # daemon's store behind the owner's back (the reference does the
-        # same with internal test hooks, ``_private/test_utils.py``).
-        core = _global_worker().backend
-        core.io.run(
-            core.daemon.call("delete_object", {"object_id": ref.id().binary()})
-        )
+        ref = produce.remote()
+        ready, _ = ray_tpu.wait([ref], timeout=120, fetch_local=False)
+        assert ready
+        cluster.remove_node(n2)
         with pytest.raises(ray_tpu.ObjectLostError):
             ray_tpu.get(ref, timeout=60)
     finally:
-        ray_tpu.shutdown()
-        cluster.shutdown()
-
-
-def test_exhausted_reconstruction_attempts_raise():
-    """A ref whose producing task is out of reconstruction attempts
-    surfaces ObjectLostError."""
-    cluster, n2 = _make_cluster()
-    try:
-        from ray_tpu.core.config import GLOBAL_CONFIG
-
-        old = GLOBAL_CONFIG.max_lineage_reconstructions
-        GLOBAL_CONFIG.max_lineage_reconstructions = 0
-        try:
-
-            @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
-            def produce():
-                return np.ones(1 << 20, dtype=np.uint8)
-
-            ref = produce.remote()
-            ready, _ = ray_tpu.wait([ref], timeout=120, fetch_local=False)
-            assert ready
-            cluster.remove_node(n2)
-            with pytest.raises(ray_tpu.ObjectLostError):
-                ray_tpu.get(ref, timeout=60)
-        finally:
-            GLOBAL_CONFIG.max_lineage_reconstructions = old
-    finally:
-        ray_tpu.shutdown()
-        cluster.shutdown()
+        GLOBAL_CONFIG.max_lineage_reconstructions = old

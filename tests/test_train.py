@@ -142,6 +142,72 @@ def test_checkpoint_manager_restore(tmp_path):
     assert mgr2.latest().to_dict()["step"] == 2
 
 
+def test_mesh_and_sharding_rules_session_plumbing():
+    """JaxBackendConfig.mesh_spec/sharding → context metadata →
+    train.get_mesh()/get_sharding_rules() (ISSUE 14 unified-plan
+    delivery). Session-level, no cluster: the trainer serializes the
+    spec as plain dataclass fields, the session rebuilds the mesh over
+    the worker's global devices."""
+    from dataclasses import asdict
+
+    import jax
+
+    from ray_tpu.parallel.mesh import FSDP, MeshSpec
+    from ray_tpu.train.session import TrainContext, _end_session, _start_session
+
+    ctx = TrainContext(
+        metadata={
+            "mesh_spec": asdict(MeshSpec(fsdp=-1)),
+            "sharding_rules": "fsdp",
+        }
+    )
+    _start_session(ctx)
+    try:
+        mesh = train.get_mesh()
+        assert mesh is not None
+        assert mesh.shape[FSDP] == len(jax.devices())  # -1 resolved globally
+        rules = train.get_sharding_rules()
+        assert rules["embed"] == FSDP and rules["batch"] is not None
+        # unconfigured keys degrade to None, unknown table names raise
+        ctx.metadata.pop("mesh_spec")
+        assert train.get_mesh() is None
+        ctx.metadata["sharding_rules"] = "zigzag"
+        with pytest.raises(ValueError, match="zigzag"):
+            train.get_sharding_rules()
+    finally:
+        _end_session()
+
+
+def test_trainer_threads_mesh_spec_into_contexts(cluster, tmp_path):
+    """The trainer delivers the SAME plan to every rank (metadata is
+    per-rank copied, not shared)."""
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    def loop(config=None):
+        ctx = train.get_context()
+        spec = ctx.metadata.get("mesh_spec")
+        train.report(
+            {
+                "rank": ctx.get_world_rank(),
+                "spec_fsdp": spec["fsdp"] if spec else None,
+                "rules": ctx.metadata.get("sharding_rules"),
+            }
+        )
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        backend_config=JaxBackendConfig(
+            distributed=False, platform="cpu",
+            mesh_spec=MeshSpec(fsdp=-1), sharding="fsdp",
+        ),
+        run_config=RunConfig(name="mesh-plumb", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.metrics["spec_fsdp"] == -1
+    assert result.metrics["rules"] == "fsdp"
+
+
 def test_scaling_config_topology_bundles():
     sc = ScalingConfig(topology="v4-32", use_tpu=True)
     assert sc.resolved_num_workers() == 4
