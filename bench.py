@@ -356,6 +356,37 @@ def bench_data_plane(results: Dict[str, Dict]) -> None:
             cluster.shutdown()
 
 
+def _collect_slo_block(results: Dict[str, Dict], phase: str, deployments) -> None:
+    """SLO-ledger block (ISSUE 15): per-deployment TTFT/ITL/e2e
+    p50/p99/p99.9 plus the goodput fraction, read from
+    ``serve.slo_report()`` while the phase's cluster is still up — the
+    first latency-DISTRIBUTION record in the trajectory files and the
+    baseline the ROADMAP item 8 traffic simulator grades against."""
+    from ray_tpu import serve
+
+    try:
+        rep = serve.slo_report(flight_limit=10)
+    except Exception as e:  # noqa: BLE001 — the block is additive
+        results.setdefault("slo", {})[phase] = {"error": repr(e)}
+        return
+    block: Dict[str, Dict] = {}
+    for name in deployments:
+        d = (rep.get("deployments") or {}).get(name)
+        if not d:
+            continue
+        block[name] = {
+            "ttft_s": d.get("ttft_s"),
+            "itl_s": d.get("itl_s"),
+            "e2e_s": d.get("e2e_s"),
+            "goodput_tokens": d.get("goodput_tokens"),
+            "fault_tokens": d.get("fault_tokens"),
+            "goodput_fraction": d.get("goodput_fraction"),
+            "books_balanced": d.get("books_balanced"),
+        }
+    results.setdefault("slo", {})[phase] = block
+    print(f"  slo[{phase}]: {json.dumps(block)}", file=sys.stderr, flush=True)
+
+
 def bench_serve_llm(results: Dict[str, Dict]) -> None:
     """LLM serving engine on the toy config, measured through the FULL
     serve streaming path (router dispatch + streaming generator + engine
@@ -630,6 +661,7 @@ def bench_serve_llm(results: Dict[str, Dict]) -> None:
             ctrl.wait_status.remote("llm_scale", min_replicas=2, timeout_s=120),
             timeout=150,
         )
+        _collect_slo_block(results, "serve", ("llm", "llm_scale"))
     finally:
         try:
             serve.shutdown()
@@ -1195,6 +1227,9 @@ def bench_disagg(results: Dict[str, Dict]) -> None:
         ):
             if k in results:
                 print(f"  {k}: {results[k]}", file=sys.stderr, flush=True)
+        _collect_slo_block(
+            results, "disagg", ("llm_disagg", "llm_disagg-prefill")
+        )
     finally:
         serve.shutdown()
         ray_tpu.shutdown()

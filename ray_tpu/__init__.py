@@ -160,17 +160,20 @@ def cluster_resources():
     return _api._global_worker().backend.cluster_resources()
 
 
-def cluster_status():
+def cluster_status(serve_slo: bool = True):
     """Live cluster state in one call (the ``ray list`` equivalent):
     ``{"nodes", "actors", "tasks": {"summary", "recent"}, "objects",
     "placement_groups", "jobs"}`` from the controller's bounded tables.
     Serve replicas are actors — their liveness shows up in ``actors``
-    within one resource-sync/poll period."""
+    within one resource-sync/poll period. When a serve controller is up
+    a ``serve_slo`` section rides along (``serve.slo_report()`` summary;
+    a per-replica fan-out — monitoring loops that only want the tables
+    should pass ``serve_slo=False``)."""
     backend = _api._global_worker().backend
     fn = getattr(backend, "cluster_status", None)
     if fn is None:
         # local mode: synthesize the same shape from what exists
-        return {
+        out = {
             "nodes": backend.nodes(),
             "actors": [],
             "tasks": {"summary": {}, "recent": []},
@@ -178,7 +181,13 @@ def cluster_status():
             "placement_groups": {},
             "jobs": [],
         }
-    return fn()
+    else:
+        out = fn()
+    if serve_slo:
+        from ray_tpu.util.state import attach_serve_slo
+
+        attach_serve_slo(out)
+    return out
 
 
 def available_resources():

@@ -160,6 +160,20 @@ class GlobalConfig:
     #: restart before unadopted restored state is rescheduled
     controller_restore_grace_s: float = 10.0
 
+    # --- SLO ledger (observability/slo.py) ---
+    #: flight-recorder slowest-K slots per process (fixed-size heap of
+    #: the slowest requests by e2e, TTFT when the request never
+    #: streamed). 0 keeps only flagged entries.
+    slo_flight_recorder_slots: int = 32
+    #: flight-recorder ring capacity for FLAGGED requests (SLO-violating,
+    #: resumed, preempted, shed, failed) — newest win
+    slo_flight_flagged_slots: int = 128
+    #: TTFT above this flags a request into the flight recorder (and the
+    #: traffic simulator's default TTFT SLO target)
+    slo_ttft_slow_s: float = 2.0
+    #: max inter-token gap above this flags a request (ITL SLO target)
+    slo_itl_slow_s: float = 1.0
+
     # --- memory monitor (``common/memory_monitor.h:52``) ---
     memory_monitor_enabled: bool = True
     #: kill the newest leased task worker when the node's available

@@ -191,13 +191,21 @@ class EngineConfig:
 
 def _engine_metrics():
     from ray_tpu.observability.metrics import Counter, Gauge
+    from ray_tpu.observability.slo import slo_metrics
 
+    slo = slo_metrics()
     return {
-        "ttft": Gauge(
-            "raytpu_llm_ttft_seconds",
-            "time from request submit to first streamed token",
-            ("quantile",),
-        ),
+        # SLO-ledger sinks (observability/slo.py): aggregatable
+        # log-bucket histograms + goodput/fault-cost counters, labeled
+        # {deployment, tenant_class}. raytpu_llm_ttft_seconds used to be
+        # a per-engine quantile GAUGE — mathematically un-aggregatable
+        # across a /federate scrape; the histogram replaces it.
+        "ttft": slo["ttft"],
+        "itl": slo["itl"],
+        "e2e": slo["e2e"],
+        "goodput": slo["goodput"],
+        "fault": slo["fault"],
+        "deadline": slo["deadline"],
         "tps": Gauge(
             "raytpu_llm_tokens_per_s",
             "decode throughput over the trailing window",
@@ -301,9 +309,23 @@ class InferenceEngine:
         #: surgically); None falls through to the env/config plan
         self.testing_fault_plan = None
         self.metrics = _engine_metrics()
-        self._ttfts: deque = deque(maxlen=512)
+        from ray_tpu.observability.slo import BucketCounts
+
+        #: per-ENGINE TTFT tape (the process-registry histogram is shared
+        #: by every engine in the process — tests host several): backs
+        #: the stats()["ttft"] p50/p99 back-compat shape
+        self._ttft_tape = BucketCounts()
+        #: deployment label for the SLO series; serve/replica.py stamps
+        #: it via LLMServer.set_deployment_name ("" for bare engines)
+        self.slo_deployment = ""
+        #: intake books — with the scheduler's queued/running counts,
+        #: submitted == finished + failed + cancelled + in_flight holds
+        #: exactly at quiesce (slo.books_balanced), the conservation gate
+        #: fault paths are reconciled against
+        self._books = {"submitted": 0, "finished": 0, "failed": 0, "cancelled": 0}
         self._token_times: deque = deque(maxlen=2048)
         self._preempt_seen = 0
+        self._replay_seen = 0
         self._prefix_seen: Dict[str, int] = {}
         #: queued KV-import jobs, executed BY the step thread at the top
         #: of each step — device cache mutation must never race the step
@@ -371,12 +393,19 @@ class InferenceEngine:
         seed: Optional[int] = None,
         timeout_s: Optional[float] = None,
         prefill_only: bool = False,
+        tenant_class: str = "",
+        ledger_stages: Optional[Dict[str, float]] = None,
+        record_slo: bool = True,
     ) -> str:
         """Enqueue a generation request; returns its id. The ambient
         ``core.deadline`` budget (or explicit ``timeout_s``, whichever is
         tighter) bounds the request end to end. ``prefill_only`` is the
         KV-migration export mode (use :meth:`prefill_kv`, which also
-        drains the payload)."""
+        drains the payload). ``tenant_class`` labels the SLO histograms;
+        ``ledger_stages`` carries stage durations measured upstream
+        (e.g. the KV import that ran before this submit);
+        ``record_slo=False`` keeps a resume attempt's warm-replay
+        latencies out of the SLO histograms (see Request.record_slo)."""
         if self._draining or not self.scheduler.admitting:
             raise EngineDrainingError("engine is draining: not admitting requests")
         prompt = [int(t) for t in prompt]
@@ -414,6 +443,9 @@ class InferenceEngine:
             deadline=Deadline.after(budget) if budget is not None else None,
             seed=seed,
             prefill_only=prefill_only,
+            tenant_class=str(tenant_class or ""),
+            ledger_stages=dict(ledger_stages or {}),
+            record_slo=bool(record_slo),
         )
         trace_wire = _tracing.current_wire()
         with self._lock:
@@ -431,6 +463,10 @@ class InferenceEngine:
                 self._trace_ctx.pop(rid, None)
                 self._submitted_at.pop(rid, None)
             raise
+        with self._lock:
+            # counted only AFTER scheduler.add succeeded: a rejected
+            # submit (queue full, draining) never entered the books
+            self._books["submitted"] += 1
         self._work.set()
         return rid
 
@@ -445,6 +481,9 @@ class InferenceEngine:
         request_id: Optional[str] = None,
         seed: Optional[int] = None,
         timeout_s: Optional[float] = None,
+        tenant_class: str = "",
+        ledger_stages: Optional[Dict[str, float]] = None,
+        record_slo: bool = True,
     ) -> Iterator[int]:
         """Submit and stream tokens as they decode. Closing/abandoning
         the iterator cancels the request and frees its blocks."""
@@ -457,6 +496,9 @@ class InferenceEngine:
             request_id=request_id,
             seed=seed,
             timeout_s=timeout_s,
+            tenant_class=tenant_class,
+            ledger_stages=ledger_stages,
+            record_slo=record_slo,
         )
         try:
             yield from self.tokens(rid)
@@ -578,6 +620,11 @@ class InferenceEngine:
         did_import = self._drain_kv_imports()
         plan = self.scheduler.schedule()
         for req in plan.reaped:
+            # every reap here is a deadline expiry (queued or running) —
+            # a fault-cost class the SLO report breaks out explicitly
+            self.metrics["deadline"].inc(
+                labels={"deployment": self.slo_deployment}
+            )
             self._finish_request(
                 req,
                 req.state,
@@ -608,6 +655,8 @@ class InferenceEngine:
             )
             req.prefill_pos = start + chunk
             n_prefill_tokens += chunk
+            if req.prefill_done and req.prefill_done_at is None:
+                req.prefill_done_at = time.monotonic()
             if req.prefill_done:
                 # the prompt's K/V is fully written: index its full
                 # blocks so later requests sharing the prefix skip them
@@ -765,8 +814,12 @@ class InferenceEngine:
         try:
             payload = None
             if n_full > 0:
+                t0 = time.monotonic()
                 blocks = self.blocks.owned(req.request_id)[:n_full]
                 kv = self.runner.gather_blocks(blocks)
+                # ledger stage: device→host gather time of the exported
+                # blocks (the disagg handoff's engine-side cost)
+                req.ledger_stages["kv_export"] = time.monotonic() - t0
                 payload = {
                     "tokens": list(prompt[: n_full * bs]),
                     "kv": kv,
@@ -872,16 +925,35 @@ class InferenceEngine:
         self._token_times.append(now)
         self.metrics["tokens_total"].inc()
         first_span: Optional[tuple] = None
+        ttft: Optional[float] = None
         with self._lock:
             q = self._out.get(req.request_id)
             if req.request_id not in self._first_token_at:
                 self._first_token_at[req.request_id] = now
                 sub = self._submitted_at.get(req.request_id)
                 if sub is not None:
-                    self._ttfts.append(now - sub)
+                    ttft = now - sub
+                    self._ttft_tape.observe(ttft)
                     wire = self._trace_ctx.get(req.request_id)
                     if wire is not None:
-                        first_span = (wire, now - sub)
+                        first_span = (wire, ttft)
+        # SLO-ledger stamps: TTFT on the first token, the inter-token
+        # gap on every later one (one histogram observe = bisect +
+        # increment; the request object carries the per-token state)
+        slo_labels = {
+            "deployment": self.slo_deployment,
+            "tenant_class": req.tenant_class,
+        }
+        if ttft is not None:
+            if req.record_slo:
+                self.metrics["ttft"].observe(ttft, labels=slo_labels)
+        elif req.last_emit_at is not None:
+            gap = now - req.last_emit_at
+            if gap > req.max_itl_s:
+                req.max_itl_s = gap
+            if req.record_slo:
+                self.metrics["itl"].observe(gap, labels=slo_labels)
+        req.last_emit_at = now
         if first_span is not None:
             # TTFT span under the caller's trace: engine admission +
             # queue + prefill chunks up to the first sampled token
@@ -915,15 +987,18 @@ class InferenceEngine:
 
     def _finish_request(self, req: Request, state: str, error: Optional[Exception]) -> None:
         outcome = {FINISHED: "finished", CANCELLED: "cancelled"}.get(state, "failed")
+        now = time.monotonic()
         with self._lock:
             q = self._out.get(req.request_id)
             submitted = self._submitted_at.pop(req.request_id, None)
             wire = self._trace_ctx.pop(req.request_id, None)
-            self._first_token_at.pop(req.request_id, None)
+            first_token = self._first_token_at.pop(req.request_id, None)
+            self._books[outcome] = self._books.get(outcome, 0) + 1
             if q is not None:
                 # the queue stays for a late tokens() call; stamp it so an
                 # abandoned stream is reaped instead of pinned forever
-                self._finished_at[req.request_id] = time.monotonic()
+                self._finished_at[req.request_id] = now
+        self._close_ledger(req, outcome, submitted, first_token, now, wire, error)
         if wire is not None and submitted is not None:
             # whole-request span under the caller's trace: admission
             # through the last decode step (covers every prefill chunk
@@ -941,6 +1016,91 @@ class InferenceEngine:
         if q is not None:
             q.put(error if error is not None else _END)
         self.metrics["requests_total"].inc(labels={"outcome": outcome})
+
+    def _close_ledger(
+        self,
+        req: Request,
+        outcome: str,
+        submitted: Optional[float],
+        first_token: Optional[float],
+        now: float,
+        wire,
+        error: Optional[Exception],
+    ) -> None:
+        """Close a request's SLO ledger: observe e2e, split its token
+        work into goodput vs fault cost, and file the flight-recorder
+        entry (flagged when the request violated an SLO target, was
+        preempted, or ended abnormally — those are exactly the outliers
+        an operator asks the recorder about)."""
+        from ray_tpu.observability.slo import flight_recorder
+
+        labels = {
+            "deployment": self.slo_deployment,
+            "tenant_class": req.tenant_class,
+        }
+        e2e = (now - submitted) if submitted is not None else None
+        ttft = (
+            first_token - submitted
+            if submitted is not None and first_token is not None
+            else None
+        )
+        if e2e is not None and req.record_slo:
+            self.metrics["e2e"].observe(e2e, labels=labels)
+        n_gen = len(req.generated)
+        if n_gen:
+            if outcome == "finished":
+                self.metrics["goodput"].inc(n_gen, labels=labels)
+            else:
+                # decode work that never reached a satisfied client is
+                # fault cost, attributed by why it was thrown away
+                self.metrics["fault"].inc(
+                    n_gen,
+                    labels={"deployment": self.slo_deployment, "reason": outcome},
+                )
+        flags: List[str] = []
+        if outcome != "finished":
+            flags.append(outcome)
+        if req.preemptions:
+            flags.append("preempted")
+        if ttft is not None and ttft > GLOBAL_CONFIG.slo_ttft_slow_s:
+            flags.append("slow_ttft")
+        if req.max_itl_s > GLOBAL_CONFIG.slo_itl_slow_s:
+            flags.append("slow_itl")
+        stages = {k: round(float(v), 6) for k, v in req.ledger_stages.items()}
+        if submitted is not None and req.admitted_at is not None:
+            stages["queue"] = round(max(0.0, req.admitted_at - submitted), 6)
+        if req.admitted_at is not None and req.prefill_done_at is not None:
+            stages["prefill"] = round(
+                max(0.0, req.prefill_done_at - req.admitted_at), 6
+            )
+        if first_token is not None:
+            stages["decode"] = round(max(0.0, now - first_token), 6)
+        entry = {
+            "tier": "engine",
+            "request_id": req.request_id,
+            "trace_id": wire[0] if wire else None,
+            "deployment": self.slo_deployment,
+            "tenant_class": req.tenant_class,
+            "outcome": outcome,
+            "error": repr(error) if error is not None else None,
+            "ttft_s": round(ttft, 6) if ttft is not None else None,
+            "e2e_s": round(e2e, 6) if e2e is not None else None,
+            "max_itl_s": round(req.max_itl_s, 6),
+            "prompt_tokens": len(req.prompt),
+            "generated_tokens": n_gen,
+            "cached_prefix_tokens": req.cached_prefix_tokens,
+            "preemptions": req.preemptions,
+            "stages": stages,
+            "flags": flags,
+        }
+        # slowest-K keys on TOTAL latency (TTFT only when the request
+        # never streamed): a fast-first-token request that then decoded
+        # for minutes is exactly the outlier the heap must retain
+        flight_recorder().add(
+            entry,
+            flagged=bool(flags),
+            slow_key=e2e if e2e is not None else ttft,
+        )
 
     def _fail_all(self, error: Exception) -> None:
         for req in self.scheduler.take_all():
@@ -976,11 +1136,16 @@ class InferenceEngine:
         return len(tt) / span
 
     def _ttft_quantiles(self) -> Dict[str, float]:
-        if not self._ttfts:
-            return {}
-        xs = sorted(self._ttfts)
-        pick = lambda f: xs[min(len(xs) - 1, int(f * (len(xs) - 1)))]
-        return {"p50": pick(0.50), "p99": pick(0.99)}
+        """stats()/bench back-compat shape ({"p50", "p99"}), now derived
+        from this engine's log-bucket TTFT tape instead of a sorted
+        sample deque (the old deque fed quantile GAUGES, which cannot be
+        aggregated across replicas — the histogram can)."""
+        with self._lock:
+            if self._ttft_tape.total == 0:
+                return {}
+            p50 = self._ttft_tape.quantile(0.50)
+            p99 = self._ttft_tape.quantile(0.99)
+        return {"p50": p50, "p99": p99}
 
     def _update_gauges(self, decode_batch: int) -> None:
         m = self.metrics
@@ -989,6 +1154,18 @@ class InferenceEngine:
         if pre > 0:
             m["preemptions_total"].inc(pre)
         self._preempt_seen = self.scheduler.total_preempted
+        # fault-cost ledger: prefill tokens readmissions had to RE-RUN
+        # (delta-tracked from the scheduler like the preemption counter)
+        replay = self.scheduler.total_replay_prefill_tokens - self._replay_seen
+        if replay > 0:
+            m["fault"].inc(
+                replay,
+                labels={
+                    "deployment": self.slo_deployment,
+                    "reason": "preempt_replay",
+                },
+            )
+            self._replay_seen = self.scheduler.total_replay_prefill_tokens
         # prefix-cache counters ride the same delta pattern (the manager
         # owns the source of truth; /metrics gets monotonic counters)
         for attr, name in (
@@ -1001,10 +1178,10 @@ class InferenceEngine:
             if cur > seen:
                 m[name].inc(cur - seen)
                 self._prefix_seen[attr] = cur
-        # the remaining gauges cost lock round-trips and a 512-entry sort
-        # (_ttft_quantiles) — at hundreds of steps/s that's pure step-loop
-        # overhead, so refresh them at 4 Hz (first step always publishes,
-        # so metric names appear on /metrics as soon as anything runs)
+        # the remaining gauges cost lock round-trips — at hundreds of
+        # steps/s that's pure step-loop overhead, so refresh them at 4 Hz
+        # (first step always publishes, so metric names appear on
+        # /metrics as soon as anything runs)
         now = time.monotonic()
         if now < self._next_gauge_refresh:
             return
@@ -1013,10 +1190,42 @@ class InferenceEngine:
         m["queue_depth"].set(self.scheduler.queue_depth())
         m["active"].set(len(self.scheduler.running))
         m["tps"].set(round(self._tokens_per_s(), 2))
-        for qname, v in self._ttft_quantiles().items():
-            m["ttft"].set(round(v, 6), labels={"quantile": qname})
 
     # -- introspection ----------------------------------------------------
+    def set_deployment_name(self, name: str) -> None:
+        """Stamp the serve deployment label onto this engine's SLO
+        series (serve/replica.py calls this through the callable before
+        any request arrives)."""
+        self.slo_deployment = str(name or "")
+
+    def ledger_books(self) -> Dict[str, Any]:
+        """Intake conservation books (slo.books_balanced): submitted ==
+        finished + failed + cancelled + queued + running, exactly, at
+        quiesce — the gate that proves no fault path (chaos kill, drain
+        cutoff, preemption churn, disconnect cancel) leaks a request."""
+        with self._lock:
+            books = dict(self._books)
+        s = self.scheduler.stats()
+        books.update(
+            kind="engine",
+            queued=s["queue_depth"],
+            running=s["running"],
+            total_admitted=s["total_admitted"],
+            replay_prefill_tokens=self.scheduler.total_replay_prefill_tokens,
+        )
+        return books
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """This process's SLO ledger state + this engine's books (the
+        serve controller's ``slo_report`` fans this out per replica)."""
+        from ray_tpu.observability import slo as _slo
+
+        snap = _slo.snapshot()
+        snap["books"] = self.ledger_books()
+        snap["tier"] = "engine"
+        snap["deployment"] = self.slo_deployment
+        return snap
+
     def stats(self) -> Dict[str, Any]:
         s = {
             "scheduler": self.scheduler.stats(),

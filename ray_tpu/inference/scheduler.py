@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -63,6 +64,21 @@ class Request:
     #: sampled; the engine gathers the full blocks to host and hands the
     #: payload to the waiting exporter instead
     prefill_only: bool = False
+    #: SLO-ledger label: the ingress priority class that admitted this
+    #: request ("" for direct callers) — rides into the latency
+    #: histograms and the flight-recorder entry
+    tenant_class: str = ""
+    #: pre-measured stage durations stamped by upstream tiers (e.g. the
+    #: decode replica's KV import ran BEFORE submit) — merged into the
+    #: ledger's stage breakdown at finish
+    ledger_stages: Dict[str, float] = field(default_factory=dict)
+    #: False for router RESUME attempts (rid.rN): the survivor's warm
+    #: replay produces an artificially fast engine-view TTFT/ITL, so
+    #: observing it into the SLO histograms would make cluster quantiles
+    #: look BETTER under failover. The client-perceived failover cost
+    #: lives in the router-tier ledger; resume attempts still book
+    #: goodput/fault tokens and file flight-recorder entries.
+    record_slo: bool = True
 
     state: str = QUEUED
     #: prompt positions already written to the KV cache (chunked prefill
@@ -84,6 +100,19 @@ class Request:
     #: prefill was skipped for them (observability)
     cached_prefix_tokens: int = 0
     arrival: int = field(default_factory=lambda: next(_seq))
+    # -- SLO-ledger lifecycle stamps (monotonic floats on the request
+    # object the scheduler/engine already pass around — the hot path
+    # pays one clock read per boundary, no allocation)
+    #: first admission into the running set (queue-wait ends here;
+    #: readmissions after preemption keep the ORIGINAL stamp — the
+    #: client-visible queue wait happened once)
+    admitted_at: Optional[float] = None
+    #: prompt K/V fully written (prefill stage ends here)
+    prefill_done_at: Optional[float] = None
+    #: last token emission (the engine derives per-token decode gaps)
+    last_emit_at: Optional[float] = None
+    #: worst inter-token gap seen (the request's ITL high-water mark)
+    max_itl_s: float = 0.0
 
     @property
     def effective_prompt(self) -> List[int]:
@@ -147,6 +176,10 @@ class ContinuousBatchingScheduler:
         self.total_preempted = 0
         self.steps_with_prefill_and_decode = 0
         self.max_decode_batch_seen = 0
+        #: prefill tokens RE-RUN because a preemption evicted their KV
+        #: (minus what the prefix cache still covered at readmission) —
+        #: the engine delta-exports this as fault-cost tokens
+        self.total_replay_prefill_tokens = 0
 
     # -- intake -----------------------------------------------------------
     def add(self, req: Request) -> None:
@@ -241,10 +274,16 @@ class ContinuousBatchingScheduler:
             req.cached_prefix_tokens = cached
             self.blocks.note_prefix_hit(cached)
             self.running.append(req)
+            if req.admitted_at is None:
+                req.admitted_at = time.monotonic()
             if req.preemptions == 0:
                 # readmissions after preemption are churn, not intake —
                 # they show up in total_preempted instead
                 self.total_admitted += 1
+            else:
+                # the fault-cost ledger: prefill work this readmission
+                # must REDO (the cache-covered prefix costs nothing)
+                self.total_replay_prefill_tokens += max(0, len(prompt) - cached)
 
     def _preempt_one(self, exclude: Request, protected_ids=frozenset()) -> bool:
         """Evict the lowest-priority, latest-arrival running request

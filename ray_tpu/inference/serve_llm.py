@@ -118,8 +118,22 @@ class LLMServer:
         the ORIGINAL request's cap — the replica subtracts what was
         already delivered, so the client-visible stream length never
         changes across failovers."""
+        import time as _time
+
         r = self._parse(request)
         resume_from = r.get("resume_from")
+        tenant_class = str(r.get("tenant_class") or "")
+        # resumable streams are observed into the SLO latency histograms
+        # by the ROUTER (slo_observer="router"): the router sees the
+        # client-perceived timeline — failover stalls count as slow
+        # gaps, samples survive replica SIGKILLs, and a resume attempt's
+        # artificially fast warm replay (resume_attempt>=1) never lands
+        # as its own sample. The engine observes only for requests no
+        # router is watching (direct callers, non-resumable streams).
+        record_slo = not (
+            r.get("resume_attempt") or r.get("slo_observer") == "router"
+        )
+        ledger_stages = {}
         desc = r.pop("kv_import", None)
         if desc is not None and not resume_from:
             # not resume_from: attempt 0 of a resumable stream carries
@@ -133,7 +147,11 @@ class LLMServer:
             # digest, pool pressure, shape mismatch — degrades to a
             # plain full prefill right here; the stream never fails
             # because of the migration.
+            t0 = _time.monotonic()
             self._import_kv(desc, r["prompt"])
+            # ledger stage: the KV fetch+scatter ran BEFORE submit, so
+            # its cost is handed to the engine's ledger as a pre-stage
+            ledger_stages["kv_import"] = _time.monotonic() - t0
         if resume_from is None:
             yield from self.engine.generate(
                 r["prompt"],
@@ -143,6 +161,9 @@ class LLMServer:
                 eos_token=r.get("eos_token"),
                 request_id=r.get("request_id"),
                 seed=r.get("seed"),
+                tenant_class=tenant_class,
+                ledger_stages=ledger_stages,
+                record_slo=record_slo,
             )
             return
         seq = int(resume_from)
@@ -180,6 +201,9 @@ class LLMServer:
             eos_token=r.get("eos_token"),
             request_id=r.get("request_id"),
             seed=r.get("seed"),
+            tenant_class=tenant_class,
+            ledger_stages=ledger_stages,
+            record_slo=record_slo,
         ):
             yield (seq, tok)
             seq += 1
@@ -264,6 +288,18 @@ class LLMServer:
         return self.engine.cancel(str(request_id))
 
     # -- introspection ----------------------------------------------------
+    def set_deployment_name(self, name: str) -> None:
+        """serve/replica.py hook: stamps the deployment label onto the
+        engine's SLO histograms/counters before any request arrives."""
+        self.engine.set_deployment_name(name)
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """SLO-ledger dump for ``serve.slo_report()``: this process's
+        latency histograms + flight recorder, plus the engine's intake
+        books (exact conservation: submitted == finished + failed +
+        cancelled + in-flight)."""
+        return self.engine.slo_snapshot()
+
     def engine_stats(self) -> Dict[str, Any]:
         return self.engine.stats()
 

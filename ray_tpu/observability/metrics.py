@@ -9,9 +9,10 @@ API as ``ray.util.metrics``."""
 
 from __future__ import annotations
 
+import bisect
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 _REGISTRY_LOCK = threading.Lock()
 _METRICS: Dict[str, "Metric"] = {}
@@ -81,6 +82,37 @@ class Gauge(Metric):
     TYPE = "gauge"
 
 
+def bucket_quantile(
+    buckets: Sequence[float], counts: Sequence[float], q: float
+) -> Optional[float]:
+    """Estimate the ``q``-quantile from histogram bucket counts
+    (``counts[i]`` = observations with value <= ``buckets[i]``;
+    ``counts[len(buckets)]`` is the +Inf overflow). Linear interpolation
+    inside the winning bucket — the Prometheus ``histogram_quantile``
+    estimator — so with log-spaced buckets of width ratio ``r`` the
+    relative error is bounded by ~``(r-1)/2``. This is what makes
+    histograms AGGREGATABLE: counts from any number of processes sum
+    element-wise and the quantile of the sum is exact to bucket
+    resolution, which no set of per-process quantile gauges can offer.
+    Returns None when the histogram is empty."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c > 0 and cum + c >= rank:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            # the +Inf bucket has no upper bound: clamp to the last
+            # finite bound (size the table so p99.9 never lands here)
+            hi = buckets[i] if i < len(buckets) else buckets[-1]
+            if hi <= lo:
+                return hi
+            return lo + (hi - lo) * max(0.0, rank - cum) / c
+        cum += c
+    return float(buckets[-1])
+
+
 #: default latency buckets (seconds): sub-ms submit stages through
 #: multi-second transfers — the envelopes this runtime actually spans
 _DEFAULT_BUCKETS = (
@@ -114,14 +146,29 @@ class Histogram(Metric):
             if ent is None:
                 # [per-bucket counts..., +Inf count, sum, count]
                 ent = self._values[k] = [0] * (len(self.buckets) + 1) + [0.0, 0]
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    ent[i] += 1
-                    break
-            else:
-                ent[len(self.buckets)] += 1
+            # bisect, not a linear scan: the SLO latency histograms run
+            # ~150 log-spaced buckets and observe() sits on the engine's
+            # per-token path (bisect_left lands on the first bound >= v;
+            # past-the-end = the +Inf slot, which IS index len(buckets))
+            ent[bisect.bisect_left(self.buckets, value)] += 1
             ent[-2] += value
             ent[-1] += 1
+
+    def counts(self, labels: Optional[Dict[str, str]] = None) -> Optional[List[float]]:
+        """Raw per-bucket counts (incl. the +Inf slot; sum and count
+        trail) for one label set — the cross-process merge unit."""
+        with self._lock:
+            ent = self._values.get(self._key(labels))
+            return list(ent) if ent is not None else None
+
+    def quantiles(
+        self, qs: Iterable[float], labels: Optional[Dict[str, str]] = None
+    ) -> Dict[float, Optional[float]]:
+        """Quantile estimates for one label set via
+        :func:`bucket_quantile` (None when nothing was observed)."""
+        ent = self.counts(labels)
+        counts = ent[: len(self.buckets) + 1] if ent is not None else ()
+        return {q: bucket_quantile(self.buckets, counts, q) for q in qs}
 
     def collect(self) -> List[str]:
         lines = [

@@ -247,6 +247,40 @@ def status() -> Dict[str, Dict[str, Any]]:
     return ray_tpu.get(controller.status.remote(), timeout=30)
 
 
+def slo_report(*, flight_limit: int = 100, timeout: float = 60.0) -> Dict[str, Any]:
+    """Cluster-wide SLO report (observability/slo.py): one call answers
+    "what were TTFT/ITL/e2e p50/p99/p99.9 per deployment (and tenant
+    class), how much of the token work was goodput vs fault cost, do the
+    intake books balance, and which stage made the slow requests slow".
+
+    The serve controller fans out to every replica for its ledger
+    snapshot (aggregatable log-bucket histogram counts + flight-recorder
+    ring + books); THIS process's own snapshot merges in too — the
+    driver-side router is a tier of the serving path (its ledger holds
+    the failover stage of resumed streams consumed here).
+
+    Report shape: ``{"deployments": {name: {"ttft_s"/"itl_s"/"e2e_s":
+    {p50, p99, p999, count}, "by_class": {...}, "goodput_tokens",
+    "fault_tokens": {reason: n}, "goodput_fraction", "deadline_expired",
+    "books": [...], "books_balanced", "restarts", "shed_total"}},
+    "flight_recorder": [joined per-request records, slowest first, each
+    with a per-tier stage breakdown, flags, resume counts, and the
+    trace id when sampled], "counters": raw merged counter values}``."""
+    from ray_tpu.observability import slo as _slo
+
+    controller = get_or_create_controller()
+    collected = ray_tpu.get(
+        controller.slo_snapshots.remote(), timeout=timeout
+    )
+    snapshots = list(collected.get("snapshots") or ())
+    local = _slo.snapshot()
+    local["tier"] = "driver"
+    snapshots.append(local)
+    return _slo.build_report(
+        snapshots, collected.get("status"), flight_limit=flight_limit
+    )
+
+
 def shutdown() -> None:
     stop_http()
     try:
@@ -298,6 +332,7 @@ __all__ = [
     "multiplexed",
     "run",
     "shutdown",
+    "slo_report",
     "start_http",
     "status",
     "stop_http",

@@ -405,6 +405,49 @@ class _ServeController:
                 t: dict(s) for t, s in self._ingress_buckets.get(key, {}).items()
             }
 
+    # -- SLO ledger (observability/slo.py) -------------------------------
+    def slo_snapshots(self, timeout_s: float = 10.0) -> Dict[str, Any]:
+        """Cluster-wide SLO-ledger collection: every replica of every
+        deployment is asked for its ``slo_snapshot`` (latency histogram
+        bucket counts, goodput/fault counters, flight-recorder ring,
+        intake books). Replicas whose callable has no ledger (plain
+        deployments) and dead/slow replicas are skipped — the report is
+        built from whoever answers, which is exactly the survivors'
+        view an operator wants mid-incident. Returns raw snapshots plus
+        ``status()``; ``serve.slo_report()`` merges and quantiles them
+        driver-side (where the driver's own router ledger joins in)."""
+        with self._lock:
+            targets = [
+                (name, r)
+                for name, st in self._deployments.items()
+                for _v, r in st.replicas
+            ]
+        pending = []
+        for name, r in targets:
+            try:
+                pending.append(
+                    (name, r.handle_request.remote("slo_snapshot", [], {}, ""))
+                )
+            except Exception:  # noqa: BLE001 — dead replica: skip
+                pass
+        snaps: List[Dict[str, Any]] = []
+        # ONE shared deadline across the whole fan-in: N wedged replicas
+        # must cost ~timeout_s total, not N*timeout_s of serialized
+        # stalls on the controller actor (every other controller RPC —
+        # status, scaling, wait_status — queues behind this loop)
+        deadline = time.monotonic() + float(timeout_s)
+        for name, ref in pending:
+            try:
+                snap = ray_tpu.get(
+                    ref, timeout=max(0.1, deadline - time.monotonic())
+                )
+            except Exception:  # noqa: BLE001 — no ledger / dead / slow
+                continue
+            if isinstance(snap, dict):
+                snap.setdefault("deployment", name)
+                snaps.append(snap)
+        return {"snapshots": snaps, "status": self.status()}
+
     def routes(self) -> Dict[str, str]:
         """route_prefix -> deployment name (proxy routing table)."""
         with self._lock:

@@ -256,6 +256,13 @@ class HttpIngress:
         self._sheds: Dict[str, int] = {}
         self._outcomes: Dict[str, int] = {}
         self._forwarded = 0
+        #: independent intake count (stamped at request entry, BEFORE
+        #: any policy runs) — the left-hand side of the ingress
+        #: conservation identity seen == shed + bad_request + forwarded
+        self._seen = 0
+        #: last flight-recorder shed entry per reason (1/s sampling —
+        #: see _count_shed)
+        self._shed_flight_at: Dict[str, float] = {}
         self.host = host
         self.port = int(port)
         # dedicated pool for the blocking stream plumbing (dispatch +
@@ -372,13 +379,42 @@ class HttpIngress:
             self._outcomes[key] = self._outcomes.get(key, 0) + 1
         requests.inc(labels={"tenant_class": tenant_class, "outcome": outcome})
 
-    def _count_shed(self, tenant_class: str, reason: str) -> None:
+    def _count_shed(self, tenant_class: str, reason: str, tenant: str = "") -> None:
         _requests, shed, _ttfb = _ingress_metrics()
         with self._lock:
             self._shed_total += 1
             self._sheds[reason] = self._sheds.get(reason, 0) + 1
         shed.inc(labels={"reason": reason})
         self._count(tenant_class, "shed")
+        # SLO ledger: sheds file flagged flight-recorder entries —
+        # capacity-protection decisions the operator audits when goodput
+        # dips — but SAMPLED at ~1/s per reason: an overload burst sheds
+        # hundreds per second, and unsampled they would flush every
+        # resumed/slow/error outlier out of the shared newest-win ring
+        # exactly when the operator needs it (totals live in the
+        # counters; the ring only needs a representative)
+        now = time.monotonic()
+        with self._lock:
+            last = self._shed_flight_at.get(reason, 0.0)
+            if now - last < 1.0:
+                return
+            self._shed_flight_at[reason] = now
+        from ray_tpu.observability.slo import flight_recorder
+
+        flight_recorder().add(
+            {
+                "tier": "ingress",
+                "request_id": None,
+                "deployment": self.cfg.target,
+                "tenant_class": tenant_class,
+                "tenant": tenant,
+                "outcome": "shed",
+                "shed_reason": reason,
+                "flags": ["shed"],
+                "stages": {},
+            },
+            flagged=True,
+        )
 
     #: bucket-table bound: past it the least-recently-used quarter is
     #: evicted (an evicted tenant's next request refills a fresh burst —
@@ -446,6 +482,8 @@ class HttpIngress:
             return web.json_response(
                 {"error": "POST a generation request"}, status=405
             )
+        with self._lock:
+            self._seen += 1
         try:
             raw = await request.read()
             body = json.loads(raw) if raw else {}
@@ -477,14 +515,14 @@ class HttpIngress:
         # downstream work; Retry-After is the exact refill wait
         retry_after = self._take(tenant, pol, cost)
         if retry_after > 0.0:
-            self._count_shed(tenant_class, "rate_limit")
+            self._count_shed(tenant_class, "rate_limit", tenant)
             return self._shed_response(web, "rate_limit", retry_after)
 
         # 2. cluster pressure — gossiped engine stats the router already
         # holds; a shed here provably never consumed an engine queue slot
         reason = shed_verdict(self._router.cluster_pressure(), priority, self.cfg)
         if reason is not None:
-            self._count_shed(tenant_class, reason)
+            self._count_shed(tenant_class, reason, tenant)
             retry = (
                 self.cfg.retry_after_s
                 if self.cfg.retry_after_s is not None
@@ -498,6 +536,15 @@ class HttpIngress:
         req["prompt"] = prompt
         req["max_new_tokens"] = max_new
         req["priority"] = priority  # the CLASS decides, never the client
+        # SLO ledger: the class labels the latency histograms downstream,
+        # and pinning the request id HERE (the first tier that sees the
+        # request) lets slo_report() join this tier's flight-recorder
+        # entry with the router's and the engine's for one request
+        req["tenant_class"] = tenant_class
+        import uuid as _uuid
+
+        req.setdefault("request_id", _uuid.uuid4().hex[:16])
+        rid = str(req["request_id"])
         req.pop("tenant", None)
         req.pop("timeout_s", None)
         budget = self._budget(request, body)
@@ -527,15 +574,48 @@ class HttpIngress:
                 tokens = await loop.run_in_executor(self._exec, list, it)
             except Exception as e:  # noqa: BLE001
                 self._count(tenant_class, "error")
+                self._flight_ttfb(rid, tenant_class, time.monotonic() - t0, "error")
                 return web.json_response({"error": repr(e)}, status=503)
             finally:
                 await loop.run_in_executor(None, _close_iterator, it)
-            ttfb.observe(time.monotonic() - t0)
+            dur = time.monotonic() - t0
+            ttfb.observe(dur)
             self._count(tenant_class, "ok")
+            self._flight_ttfb(rid, tenant_class, dur, "ok")
             return web.json_response({"tokens": tokens})
-        return await self._stream_sse(request, it, tenant_class, t0)
+        return await self._stream_sse(request, it, tenant_class, t0, rid)
 
-    async def _stream_sse(self, request, it, tenant_class: str, t0: float):
+    def _flight_ttfb(
+        self, rid: str, tenant_class: str, ttfb_s: float, outcome: str
+    ) -> None:
+        """File an ingress-tier flight entry for a slow or failed
+        request (cheap predicate per request; the joined record then
+        shows whether the time went to the door, the router, or the
+        engine)."""
+        slow = ttfb_s > GLOBAL_CONFIG.slo_ttft_slow_s
+        if not slow and outcome == "ok":
+            return
+        from ray_tpu.observability.slo import flight_recorder
+
+        flags = (["slow_ttfb"] if slow else []) + (
+            [outcome] if outcome != "ok" else []
+        )
+        flight_recorder().add(
+            {
+                "tier": "ingress",
+                "request_id": rid,
+                "deployment": self.cfg.target,
+                "tenant_class": tenant_class,
+                "outcome": outcome,
+                "ttft_s": round(ttfb_s, 6),
+                "stages": {"ttfb": round(ttfb_s, 6)},
+                "flags": flags,
+            },
+            flagged=True,
+            slow_key=ttfb_s,
+        )
+
+    async def _stream_sse(self, request, it, tenant_class: str, t0: float, rid: str = ""):
         """SSE the stream out. Once the response is prepared this ALWAYS
         returns it; a client disconnect must not bubble out (a second
         response would be sent) and MUST close the value iterator — that
@@ -553,6 +633,7 @@ class HttpIngress:
         await resp.prepare(request)
         outcome = "ok"
         first = True
+        first_dur: Optional[float] = None
         try:
             while True:
                 try:
@@ -568,7 +649,8 @@ class HttpIngress:
                     break
                 if first:
                     first = False
-                    ttfb.observe(time.monotonic() - t0)
+                    first_dur = time.monotonic() - t0
+                    ttfb.observe(first_dur)
                 await resp.write(f"data: {json.dumps(item)}\n\n".encode())
             await resp.write_eof()
         except (ConnectionError, asyncio.CancelledError):
@@ -576,6 +658,12 @@ class HttpIngress:
         finally:
             await loop.run_in_executor(None, _close_iterator, it)
             self._count(tenant_class, outcome)
+            self._flight_ttfb(
+                rid,
+                tenant_class,
+                first_dur if first_dur is not None else time.monotonic() - t0,
+                outcome,
+            )
         return resp
 
     @staticmethod
@@ -602,6 +690,43 @@ class HttpIngress:
                 "forwarded_total": self._forwarded,
                 "ingress": True,
             }
+
+    def ledger_books(self) -> Dict[str, Any]:
+        """Front-door conservation books (slo.books_balanced): every
+        request seen was shed, rejected as bad input, or forwarded —
+        exactly one of the three, so ``seen == shed + bad_request +
+        forwarded`` holds at all times (each request increments its
+        bucket BEFORE the handler returns)."""
+        with self._lock:
+            bad = sum(
+                v for k, v in self._outcomes.items()
+                if k.endswith(":bad_request")
+            )
+            completed = sum(
+                v for k, v in self._outcomes.items()
+                if k.split(":", 1)[1] in ("ok", "error", "disconnect")
+            )
+            return {
+                "kind": "ingress",
+                "seen": self._seen,
+                "shed": self._shed_total,
+                "bad_request": bad,
+                "forwarded": self._forwarded,
+                "completed": completed,
+                "in_flight": self._forwarded - completed,
+            }
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """SLO-ledger dump for ``serve.slo_report()``: this door
+        process's flight recorder + counters (its ROUTER lives here too,
+        so resumed-stream entries ride along) plus the ingress books."""
+        from ray_tpu.observability import slo as _slo
+
+        snap = _slo.snapshot()
+        snap["books"] = self.ledger_books()
+        snap["tier"] = "ingress"
+        snap["deployment"] = self.cfg.target
+        return snap
 
     def debug_stats(self) -> Dict[str, Any]:
         """Full counter snapshot for tests/operators: shed breakdown,

@@ -34,6 +34,14 @@ class _Replica:
         self._deployment = deployment
         self._controller_namespace = controller_namespace
         self._reported_models: list = []
+        # SLO-ledger label hook: a callable that wants its metrics
+        # labeled by deployment (the LLM engine's TTFT/ITL/e2e series)
+        # learns its name here, BEFORE any request can arrive
+        if deployment and hasattr(self._callable, "set_deployment_name"):
+            try:
+                self._callable.set_deployment_name(deployment)
+            except Exception:  # noqa: BLE001 — labeling must not fail init
+                pass
         # routing-stats gossip (cache-affinity routing): a callable that
         # exposes routing_stats() gets a reporter thread pushing load +
         # prefix digest to the controller on a timer — request-driven

@@ -91,10 +91,18 @@ def _count_stream_resume(deployment: str, replayed_tokens: int) -> None:
         STREAM_RESUME_REPLAY_TOKENS,
         STREAM_RESUMES,
     )
+    from ray_tpu.observability.slo import slo_metrics
 
     STREAM_RESUMES.inc(labels={"deployment": deployment})
     if replayed_tokens > 0:
         STREAM_RESUME_REPLAY_TOKENS.inc(replayed_tokens)
+        # the same increment feeds the SLO ledger's fault-cost split:
+        # replayed tokens are work a fault forced (mostly absorbed by
+        # the survivor's radix cache, but never goodput)
+        slo_metrics()["fault"].inc(
+            replayed_tokens,
+            labels={"deployment": deployment, "reason": "resume_replay"},
+        )
 
 
 def _request_prompt(args) -> Optional[List[int]]:
@@ -767,113 +775,244 @@ class Router:
         gate = SeqGate(0)
         delivered: List[int] = []
         item_timeout = budget
+        # router-tier SLO ledger: the router is the only tier that SEES
+        # a failover (the engines on either side each saw a normal
+        # request), so the stage that makes a resumed outlier slow —
+        # detection + re-dispatch + warm replay — is stamped here and
+        # joined with the engine-tier entries by request id in
+        # serve.slo_report()
+        led: Dict[str, Any] = {
+            "tier": "router",
+            "request_id": base_rid,
+            "deployment": self._deployment,
+            "tenant_class": str(req.get("tenant_class") or ""),
+            "trace_id": None,
+            "outcome": "abandoned",
+            "resumes": 0,
+            "replayed_tokens": 0,
+            "stages": {},
+            "flags": [],
+        }
+        # resumable streams observe the SLO latency histograms at THIS
+        # tier, not the engine: the router sees what the client sees —
+        # failover stalls count as real (slow) inter-token gaps, and the
+        # samples survive a replica SIGKILL (an engine's in-memory
+        # counts die with its process; the consumer's don't). The
+        # replicas are told to stand down via ``slo_observer`` so one
+        # request is never observed twice.
+        from ray_tpu.observability.slo import slo_metrics
+
+        _slo_hist = slo_metrics()
+        _slo_labels = {
+            "deployment": self._deployment,
+            "tenant_class": led["tenant_class"],
+        }
+
+        def _finalize_led(t_start: float, first_at: Optional[float]) -> None:
+            now = time.monotonic()
+            if first_at is not None:
+                led["ttft_s"] = round(first_at - t_start, 6)
+            led["e2e_s"] = round(now - t_start, 6)
+            if led["outcome"] != "abandoned":
+                # a walked-away client's e2e is its own choice, not
+                # service latency; completed and failed streams count
+                _slo_hist["e2e"].observe(now - t_start, labels=_slo_labels)
+            flags = []
+            if led["resumes"]:
+                flags.append("resumed")
+            if led["outcome"] == "error":
+                flags.append("error")
+            if (
+                led.get("ttft_s") is not None
+                and led["ttft_s"] > GLOBAL_CONFIG.slo_ttft_slow_s
+            ):
+                flags.append("slow_ttft")
+            if led.get("max_itl_s", 0.0) > GLOBAL_CONFIG.slo_itl_slow_s:
+                flags.append("slow_itl")
+            led["flags"] = flags
+            from ray_tpu.observability.slo import flight_recorder
+
+            flight_recorder().add(
+                led,
+                flagged=bool(flags),
+                slow_key=led["e2e_s"],
+            )
 
         def _gen():
+            wire = _tracing.current_wire()
+            if wire is not None:
+                led["trace_id"] = wire[0]
+            t_start = time.monotonic()
+            first_at: Optional[float] = None
+            last_tok_at: Optional[float] = None
+            #: set when a failover is in progress: the wall time the
+            #: death was observed — the next delivered token closes the
+            #: "failover" stage (detection + re-dispatch + warm replay,
+            #: measured from the LAST delivered token when one exists:
+            #: that gap is exactly what the client perceived)
+            failover_since: Optional[float] = None
             attempt = 0
             barren = 0
             last_err: Optional[Exception] = None
-            while True:
-                attempt_req = dict(req)
-                attempt_req["resume_from"] = gate.next_seq
-                if attempt:
-                    # replay identity: same logical request, new engine
-                    # intake (a replica that already saw base_rid — e.g.
-                    # one that stalled and recovered — must not reject
-                    # the resume as a duplicate submission)
-                    attempt_req["prompt"] = base_prompt + delivered
-                    attempt_req["request_id"] = f"{base_rid}.r{attempt}"
-                    # the KV descriptor belongs to attempt 0's dispatch:
-                    # a resume survivor warm-replays through its own
-                    # radix cache (PR 10); re-importing would add a
-                    # transfer to the failover path for nothing
-                    attempt_req.pop("kv_import", None)
-                # per-attempt budget: a resume is a fresh dispatch +
-                # time-to-next-token window, not a continuation of the
-                # first attempt's (possibly spent) dispatch budget
-                deadline = Deadline.after(budget if budget is not None else 3600)
-                progress_before = gate.next_seq
-                replica = None
-                gen = None
-                try:
+            try:
+                while True:
+                    attempt_req = dict(req)
+                    attempt_req["resume_from"] = gate.next_seq
+                    # this tier owns the latency histograms (see above):
+                    # the replica's engine must not observe its own —
+                    # possibly warm-replayed — view of the same request
+                    attempt_req["slo_observer"] = "router"
+                    if attempt:
+                        # replay identity: same logical request, new engine
+                        # intake (a replica that already saw base_rid — e.g.
+                        # one that stalled and recovered — must not reject
+                        # the resume as a duplicate submission)
+                        attempt_req["prompt"] = base_prompt + delivered
+                        attempt_req["request_id"] = f"{base_rid}.r{attempt}"
+                        # mark the attempt so the replica keeps its warm
+                        # replay OUT of the SLO latency histograms (the
+                        # failover cost the client saw is stamped on THIS
+                        # tier's ledger entry below)
+                        attempt_req["resume_attempt"] = attempt
+                        # the KV descriptor belongs to attempt 0's dispatch:
+                        # a resume survivor warm-replays through its own
+                        # radix cache (PR 10); re-importing would add a
+                        # transfer to the failover path for nothing
+                        attempt_req.pop("kv_import", None)
+                    # per-attempt budget: a resume is a fresh dispatch +
+                    # time-to-next-token window, not a continuation of the
+                    # first attempt's (possibly spent) dispatch budget
+                    deadline = Deadline.after(budget if budget is not None else 3600)
+                    progress_before = gate.next_seq
+                    replica = None
+                    gen = None
                     try:
-                        replica = self.choose_replica(model_id, [attempt_req])
-                    except RuntimeError as e:
-                        # "no replicas": every candidate died and the
-                        # controller's replacement hasn't registered yet
-                        # — a routing condition, not a stream failure;
-                        # retry under the barren-attempt bound
-                        last_err = e
-                        barren += 1
-                        if barren >= _MAX_BARREN_RESUMES:
-                            raise
-                        attempt += 1
-                        continue
-                    self._bump(replica)
-                    gen = replica.handle_request_streaming.options(
-                        num_returns="streaming"
-                    ).remote(
-                        method, [attempt_req] + extra_args,
-                        dict(kwargs or {}), model_id,
-                    )
-                    first = True
-                    while True:
                         try:
-                            if first:
-                                # bounded time-to-first(-resumed)-item
-                                ref = gen.next_with_timeout(
-                                    max(1.0, deadline.remaining())
-                                )
-                            else:
-                                # production wait is unbounded, like the
-                                # non-resumable path: a slow producer is
-                                # backpressure, and a DEAD one fails the
-                                # stream (waking this wait) regardless
-                                ref = gen.next_with_timeout(None)
-                        except StopIteration:
-                            return
-                        item = ray_tpu.get(
-                            ref,
-                            timeout=max(1.0, deadline.remaining())
-                            if first
-                            else item_timeout,
+                            replica = self.choose_replica(model_id, [attempt_req])
+                        except RuntimeError as e:
+                            # "no replicas": every candidate died and the
+                            # controller's replacement hasn't registered yet
+                            # — a routing condition, not a stream failure;
+                            # retry under the barren-attempt bound
+                            last_err = e
+                            barren += 1
+                            if barren >= _MAX_BARREN_RESUMES:
+                                raise
+                            attempt += 1
+                            continue
+                        self._bump(replica)
+                        gen = replica.handle_request_streaming.options(
+                            num_returns="streaming"
+                        ).remote(
+                            method, [attempt_req] + extra_args,
+                            dict(kwargs or {}), model_id,
                         )
-                        first = False
-                        try:
-                            seq, token = item
-                        except (TypeError, ValueError):
-                            # a redeploy swapped in a callable that no
-                            # longer speaks the seq protocol while this
-                            # stream (or a stale cache window) was live
-                            raise RuntimeError(
-                                f"resumable stream {self._deployment}."
-                                f"{method} yielded {type(item).__name__}, "
-                                "not a (seq, item) pair — was the "
-                                "deployment redeployed without "
-                                "resumable_streams?"
-                            ) from None
-                        if gate.admit(seq):
-                            delivered.append(token)
-                            barren = 0
-                            yield token
-                except _REPLICA_GONE as e:
-                    last_err = e
-                    if replica is not None:
-                        self._drop_replica(replica)
-                    if gate.next_seq == progress_before:
-                        barren += 1
-                        if barren >= _MAX_BARREN_RESUMES:
-                            raise
-                    attempt += 1
-                    _count_stream_resume(self._deployment, len(delivered))
-                    continue
-                finally:
-                    # every exit — normal end, failover to the next
-                    # attempt, consumer close (GeneratorExit lands at the
-                    # yield above) — releases this attempt's ref stream
-                    # and cancels a still-running producer, so a client
-                    # that disconnects mid-stream frees the engine slot
-                    if gen is not None:
-                        gen.abandon()
+                        first = True
+                        while True:
+                            try:
+                                if first:
+                                    # bounded time-to-first(-resumed)-item
+                                    ref = gen.next_with_timeout(
+                                        max(1.0, deadline.remaining())
+                                    )
+                                else:
+                                    # production wait is unbounded, like the
+                                    # non-resumable path: a slow producer is
+                                    # backpressure, and a DEAD one fails the
+                                    # stream (waking this wait) regardless
+                                    ref = gen.next_with_timeout(None)
+                            except StopIteration:
+                                led["outcome"] = "ok"
+                                return
+                            item = ray_tpu.get(
+                                ref,
+                                timeout=max(1.0, deadline.remaining())
+                                if first
+                                else item_timeout,
+                            )
+                            first = False
+                            try:
+                                seq, token = item
+                            except (TypeError, ValueError):
+                                # a redeploy swapped in a callable that no
+                                # longer speaks the seq protocol while this
+                                # stream (or a stale cache window) was live
+                                raise RuntimeError(
+                                    f"resumable stream {self._deployment}."
+                                    f"{method} yielded {type(item).__name__}, "
+                                    "not a (seq, item) pair — was the "
+                                    "deployment redeployed without "
+                                    "resumable_streams?"
+                                ) from None
+                            if gate.admit(seq):
+                                now = time.monotonic()
+                                if first_at is None:
+                                    first_at = now
+                                    _slo_hist["ttft"].observe(
+                                        now - t_start, labels=_slo_labels
+                                    )
+                                elif last_tok_at is not None:
+                                    # the client-perceived gap: a
+                                    # failover stall lands HERE as one
+                                    # honest slow sample
+                                    gap = now - last_tok_at
+                                    if gap > led.get("max_itl_s", 0.0):
+                                        led["max_itl_s"] = round(gap, 6)
+                                    _slo_hist["itl"].observe(
+                                        gap, labels=_slo_labels
+                                    )
+                                if failover_since is not None:
+                                    # the failover stage the client saw:
+                                    # last delivered token (or the death,
+                                    # when none was) → first resumed token
+                                    led["stages"]["failover"] = round(
+                                        led["stages"].get("failover", 0.0)
+                                        + (
+                                            now
+                                            - (
+                                                last_tok_at
+                                                if last_tok_at is not None
+                                                else failover_since
+                                            )
+                                        ),
+                                        6,
+                                    )
+                                    failover_since = None
+                                last_tok_at = now
+                                delivered.append(token)
+                                barren = 0
+                                yield token
+                    except _REPLICA_GONE as e:
+                        last_err = e
+                        if replica is not None:
+                            self._drop_replica(replica)
+                        if gate.next_seq == progress_before:
+                            barren += 1
+                            if barren >= _MAX_BARREN_RESUMES:
+                                raise
+                        attempt += 1
+                        led["resumes"] += 1
+                        led["replayed_tokens"] += len(delivered)
+                        if failover_since is None:
+                            failover_since = time.monotonic()
+                        _count_stream_resume(self._deployment, len(delivered))
+                        continue
+                    finally:
+                        # every exit — normal end, failover to the next
+                        # attempt, consumer close (GeneratorExit lands at the
+                        # yield above) — releases this attempt's ref stream
+                        # and cancels a still-running producer, so a client
+                        # that disconnects mid-stream frees the engine slot
+                        if gen is not None:
+                            gen.abandon()
+            except GeneratorExit:
+                raise  # consumer walked away: outcome stays "abandoned"
+            except BaseException as e:
+                led["outcome"] = "error"
+                led["error"] = repr(e)
+                raise
+            finally:
+                _finalize_led(t_start, first_at)
 
         # prime the first token eagerly (matching the non-resumable
         # path: dispatch problems raise at call time, not first next())

@@ -47,9 +47,33 @@ def summarize_tasks() -> Dict[str, int]:
     return out
 
 
-def cluster_status() -> Dict[str, Any]:
-    """One-call live cluster view (``ray_tpu.cluster_status()``)."""
-    return _call("cluster_status")
+def attach_serve_slo(out: Dict[str, Any]) -> Dict[str, Any]:
+    """Best-effort ``serve_slo`` section for a cluster-status dict: the
+    per-deployment SLO summary (TTFT/ITL/e2e p50/p99/p99.9, goodput
+    fraction, book balance) from ``serve.slo_report()`` with a trimmed
+    flight-recorder dump. Absent when serving isn't up (plain clusters
+    must not pay a fan-out) or the controller is mid-failover."""
+    try:
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        ray_tpu.get_actor(CONTROLLER_NAME)  # raises when serving is down
+        from ray_tpu import serve
+
+        out["serve_slo"] = serve.slo_report(flight_limit=20, timeout=10)
+    except Exception:  # noqa: BLE001 — no serve tier, or it is mid-failover
+        pass
+    return out
+
+
+def cluster_status(serve_slo: bool = True) -> Dict[str, Any]:
+    """One-call live cluster view (``ray_tpu.cluster_status()``). When a
+    serve controller is running a ``serve_slo`` section rides along (see
+    :func:`attach_serve_slo`); that is a per-replica fan-out, so
+    high-frequency monitoring loops that only want the control-plane
+    tables should pass ``serve_slo=False``."""
+    out = _call("cluster_status")
+    return attach_serve_slo(out) if serve_slo else out
 
 
 def cluster_telemetry() -> Dict[str, Any]:

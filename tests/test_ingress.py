@@ -255,6 +255,40 @@ def test_http_ingress_disconnect_shed_and_reconcile(cfg, params):
                 headers={"Content-Type": "application/json"},
             ), timeout=30)
         assert ei.value.code == 400
+
+        # -- 5. ISSUE 15 SLO-ledger books over the same traffic: the
+        # ingress conservation identity (seen == shed + bad_request +
+        # forwarded) and the engine identity (submitted == finished +
+        # failed + cancelled + in-flight) both balance EXACTLY through
+        # serve.slo_report() — sheds, the disconnect-cancel, and the
+        # 400 all landed in exactly one bucket each
+        from ray_tpu.observability import slo as _slo
+
+        deadline = time.monotonic() + 20
+        while True:
+            rep = serve.slo_report()
+            books = [b for d in rep["deployments"].values() for b in d["books"]]
+            if books and all(b["balanced"] for b in books):
+                break
+            assert time.monotonic() < deadline, books
+            time.sleep(0.5)
+        ing_books = [b for b in books if b.get("kind") == "ingress"]
+        eng_books = [b for b in books if b.get("kind") == "engine"]
+        assert ing_books and eng_books, books
+        ib = ing_books[0]
+        assert ib["shed"] == shed and ib["bad_request"] == 1, ib
+        assert ib["seen"] == ib["shed"] + ib["bad_request"] + ib["forwarded"]
+        assert _slo.books_balanced(ib) and _slo.books_balanced(eng_books[0])
+        # the aggregated histograms carry the classes the door stamped
+        llm = rep["deployments"]["llm"]
+        assert llm["ttft_s"]["count"] > 0 and llm["by_class"], llm
+        assert "interactive" in llm["by_class"] or "batch" in llm["by_class"]
+        # shed requests left flagged ingress flight-recorder entries
+        sheds_rec = [
+            r for r in rep["flight_recorder"]
+            if "shed" in (r.get("flags") or ())
+        ]
+        assert sheds_rec, rep["flight_recorder"][:5]
     finally:
         serve.shutdown()
         ray_tpu.shutdown()

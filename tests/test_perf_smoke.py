@@ -88,10 +88,14 @@ def test_decode_step_throughput_smoke():
     ~1500 tokens/s warm on this box — 100/s trips only an
     order-of-magnitude regression (per-token recompiles, the decode
     batch falling apart into singletons, a python hot loop in the
-    step path)."""
+    step path). The SLO ledger (ISSUE 15) is ALWAYS-ON in this path —
+    per-token histogram observes, lifecycle stamps, flight-recorder
+    inserts — so this floor doubles as the ledger-overhead guard:
+    observability can never become the regression."""
     jax = pytest.importorskip("jax")
     from ray_tpu.inference.engine import EngineConfig, InferenceEngine
     from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.observability import slo
 
     cfg = LlamaConfig.tiny()
     params = init_params(cfg, jax.random.PRNGKey(0))
@@ -112,6 +116,19 @@ def test_decode_step_throughput_smoke():
         assert total == 4 * 32
         assert eng.runner.recompiles_after_warmup() == 0
         assert rate >= 100, f"decode throughput collapsed: {rate:.0f} tokens/s"
+        # the ledger provably ran during the measured window (this floor
+        # is its overhead gate, so it must not be silently off) and its
+        # books balance exactly at quiesce
+        deadline = time.monotonic() + 10
+        books = eng.ledger_books()
+        while time.monotonic() < deadline and not slo.books_balanced(books):
+            time.sleep(0.05)
+            books = eng.ledger_books()
+        assert slo.books_balanced(books), books
+        assert books["submitted"] == 8 and books["finished"] == 8, books
+        snap = eng.slo_snapshot()
+        itl = snap["histograms"]["raytpu_llm_itl_seconds"]["values"]
+        assert sum(v[-1] for v in itl.values()) >= 4 * 31, "ITL ledger idle"
     finally:
         eng.stop()
 

@@ -237,6 +237,9 @@ def test_e2e_hot_replica_killed_mid_decode_byte_exact(cfg, params):
     from ray_tpu.core.config import GLOBAL_CONFIG
     from ray_tpu.observability.rpc_metrics import STREAM_RESUMES
 
+    from ray_tpu.observability import slo as _slo
+    from ray_tpu.observability.rpc_metrics import STREAM_RESUME_REPLAY_TOKENS
+
     SPEC, SEED = "kill_mid_decode:1.0:6", 20260804
     ec = EngineConfig(
         num_blocks=64, block_size=8, prefill_buckets=(8, 32),
@@ -296,13 +299,24 @@ def test_e2e_hot_replica_killed_mid_decode_byte_exact(cfg, params):
         time.sleep(3 * GLOBAL_CONFIG.serve_replica_stats_period_s)
 
         resumes_before = STREAM_RESUMES._values.get(("llmx",), 0.0)
+        replay_before = STREAM_RESUME_REPLAY_TOKENS._values.get((), 0.0)
+        # ISSUE 15 ledger setup: sampled traces give the router ledger a
+        # resolvable trace id (restored in finally — the observability
+        # module asserts the default stays 0), and the driver-recorder
+        # high-water mark isolates THIS test's entries for the exact
+        # replay-token reconcile
+        GLOBAL_CONFIG.trace_sample_rate = 1.0
+        led_before_ids = {
+            e.get("request_id") for e in _slo.flight_recorder().snapshot()
+        }
         results, errors = {}, {}
 
         def consume(i):
             try:
                 results[i] = list(handle.stream(
                     {"prompt": prompts[i], "max_new_tokens": max_new,
-                     "temperature": 0.7, "seed": 100 + i},
+                     "temperature": 0.7, "seed": 100 + i,
+                     "request_id": f"slo{i}"},
                     _method="generate", _timeout=180,
                 ))
             except Exception as e:  # noqa: BLE001
@@ -335,7 +349,66 @@ def test_e2e_hot_replica_killed_mid_decode_byte_exact(cfg, params):
         s1 = [p1.consult(p) for p in phases]
         assert s1 == [p2.consult(p) for p in phases]
         assert p1.injections == 1 and ("kill_mid_decode", 6.0) in s1
+
+        # -- ISSUE 15 acceptance: the SLO ledger on the SAME chaos run.
+        # serve.slo_report() aggregates the replicas' log-bucket
+        # histograms (p50/p99/p99.9 from summed counts — the thing the
+        # old quantile gauges could never do), reconciles the intake
+        # books exactly, and hands back the joined flight record of the
+        # resumed requests with the failover stage named.
+        rep = serve.slo_report()
+        dep = rep["deployments"].get("llmx")
+        assert dep, list(rep["deployments"])
+        for key in ("ttft_s", "itl_s", "e2e_s"):
+            blk = dep[key]
+            assert blk["count"] > 0 and blk.get("p50") is not None, (key, blk)
+            assert blk.get("p999") is not None, (key, blk)
+        # books: every live engine balances exactly — chaos kills,
+        # resumes, and cancels may not leak one unaccounted request
+        # (finish→book increments quiesce within a beat of idle)
+        deadline_b = time.monotonic() + 20
+        while time.monotonic() < deadline_b and not dep.get("books_balanced"):
+            time.sleep(0.5)
+            rep = serve.slo_report()
+            dep = rep["deployments"]["llmx"]
+        assert dep["books_balanced"] is True, dep["books"]
+        assert dep["books"], rep
+        # goodput split from fault cost: the replayed tokens of every
+        # resume were booked as fault, not goodput
+        assert dep["goodput_tokens"] > 0, dep
+        assert dep["fault_tokens"].get("resume_replay", 0) > 0, dep
+        # flight recorder: a resumed request's joined record names the
+        # failover stage and carries a resolvable trace id
+        ours = [
+            r for r in rep["flight_recorder"]
+            if str(r["request_id"]).startswith("slo") and r["resumes"] > 0
+        ]
+        assert ours, [r["request_id"] for r in rep["flight_recorder"][:10]]
+        rec = ours[0]
+        assert rec["stages"].get("router.failover", 0) > 0, rec
+        assert rec["slowest_stage"], rec
+        assert rec.get("trace_id"), rec
+        trace_ids = {
+            (e.get("args") or {}).get("trace_id") for e in ray_tpu.timeline()
+        }
+        assert rec["trace_id"] in trace_ids, rec["trace_id"]
+        # exact replay reconcile: the ledger entries this test created
+        # sum to precisely what raytpu_stream_resume_replay_tokens_total
+        # advanced by — same increments, observed via two sinks
+        replay_delta = (
+            STREAM_RESUME_REPLAY_TOKENS._values.get((), 0.0) - replay_before
+        )
+        led_new = [
+            e for e in _slo.flight_recorder().snapshot()
+            if e.get("tier") == "router"
+            and e.get("request_id") not in led_before_ids
+        ]
+        assert replay_delta == sum(e["replayed_tokens"] for e in led_new), (
+            replay_delta, [(e["request_id"], e["replayed_tokens"]) for e in led_new]
+        )
+        assert replay_delta > 0
     finally:
+        GLOBAL_CONFIG.trace_sample_rate = 0.0
         GLOBAL_CONFIG.serve_affinity_weight = old_weight
         # the plan must not outlive this test: a later test's cluster
         # (or a driver-local engine, had config been touched) would
