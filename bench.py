@@ -1050,6 +1050,134 @@ def bench_ingress(results: Dict[str, Dict]) -> None:
         ray_tpu.shutdown()
 
 
+def bench_slo_autopilot(results: Dict[str, Dict]) -> None:
+    """SLO autopilot (serve/loadgen.py + controller closed loop): the
+    SAME seeded chaos trace — heavy-tailed bursty tenant mix with a
+    seeded mid-run replica kill, everything derived from ONE master
+    chaos seed — replayed twice: against a static single-replica
+    deployment with a static shed watermark, then against the closed
+    loop (TTFT-burn autoscaling + ITL-derived shed). Reports TTFT-p99
+    attainment for both, the attainment ratio, the autoscaler lag, and
+    the master seed that replays the whole run."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.inference.engine import EngineConfig
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve import loadgen
+    from ray_tpu.serve.config import AutoscalingConfig
+    from ray_tpu.serve.ingress import IngressConfig
+
+    MASTER = 20260806
+    TTFT_SLO, ITL_SLO = 2.0, 1.0
+    spec = loadgen.LoadSpec(
+        seed=MASTER,
+        duration_s=15.0,
+        base_rate_rps=3.0,
+        burst_factor=3.0,
+        n_tenants=4,
+        prompt_min=3,
+        prompt_max=16,
+        prefix_len=4,
+        output_min=4,
+        output_max=12,
+        chaos_master_seed=MASTER,
+        # one mid-run kill per replica LIFETIME (200th decode consult):
+        # the static pool eats the stall with its whole capacity gone;
+        # the closed loop's scale-out splits the consult stream so the
+        # extra replicas outlive the trace and drain the backlog
+        replica_chaos="kill_mid_decode:1.0:200:1",
+    )
+    trace = loadgen.build_trace(spec)
+
+    def one_run(closed_loop: bool):
+        # chaos env must be exported BEFORE init so replica processes
+        # inherit the (master-derived) fault plans — both runs see the
+        # exact same injection schedule
+        for k, v in loadgen.chaos_env(spec).items():
+            os.environ[k] = v
+        ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 4)))
+        try:
+            ec = EngineConfig(
+                num_blocks=64, block_size=8, prefill_buckets=(8, 16, 32),
+                decode_buckets=(1, 2, 4, 8), max_decode_batch=8,
+            )
+            autoscale = (
+                AutoscalingConfig(
+                    min_replicas=1, max_replicas=3,
+                    target_ongoing_requests=4.0,
+                    target_ttft_p99_s=TTFT_SLO / 2,
+                    upscale_delay_s=0.5, downscale_delay_s=60.0,
+                )
+                if closed_loop
+                else None
+            )
+            serve.run(serve.llm_deployment(
+                LlamaConfig.tiny(), engine=ec,
+                autoscaling_config=autoscale,
+            ).bind())
+            ing_cfg = IngressConfig(
+                target="llm",
+                default_rate=1e6, default_burst=1e6,
+                shed_itl_target_s=ITL_SLO if closed_loop else None,
+            )
+            serve.run(
+                serve.ingress_deployment("llm", ing_cfg, name="ingress").bind(),
+                name="ingress",
+            )
+            addrs = serve.ingress_addresses("ingress")
+            from ray_tpu.serve.ingress import http_stream
+            list(http_stream(
+                addrs[0], {"prompt": [1, 2, 3], "max_new_tokens": 4},
+            ))  # route + stream path hot before the clock starts
+            run = loadgen.run_trace(
+                trace, spec=spec, addresses=addrs,
+                timeout_s=120.0, status_fn=serve.status,
+            )
+            return loadgen.score(
+                run, ttft_slo_s=TTFT_SLO, itl_slo_s=ITL_SLO,
+                report=serve.slo_report(), status=serve.status(),
+            )
+        finally:
+            serve.shutdown()
+            ray_tpu.shutdown()
+            for k in loadgen.chaos_env(spec):
+                os.environ.pop(k, None)
+            from ray_tpu.core.config import GLOBAL_CONFIG
+            GLOBAL_CONFIG.testing_chaos_seed = 0
+            GLOBAL_CONFIG.testing_replica_chaos = ""
+
+    static = one_run(closed_loop=False)
+    closed = one_run(closed_loop=True)
+    ratio = (
+        round(closed["ttft_attainment"] / static["ttft_attainment"], 3)
+        if static["ttft_attainment"]
+        else None
+    )
+    results["slo_autopilot_ttft_attainment"] = {
+        "value": closed["ttft_attainment"],
+        "static": static["ttft_attainment"],
+        "vs_static": ratio,
+        "ttft_p99_s": {
+            "closed_loop": round(closed["ttft"]["p99"], 3),
+            "static": round(static["ttft"]["p99"], 3),
+        },
+        "errors": {"closed_loop": closed["errors"], "static": static["errors"]},
+        "autoscaler_lag_s": closed.get("autoscaler_lag_s"),
+        "chaos_master_seed": MASTER,
+        "repro": closed["repro"],
+        "unit": (
+            f"TTFT-p99 attainment fraction at {TTFT_SLO}s SLO, "
+            f"{len(trace)} seeded requests + mid-run replica kill "
+            "(closed loop vs static baseline, identical chaos schedule)"
+        ),
+    }
+    print(
+        f"  slo_autopilot_ttft_attainment: "
+        f"{results['slo_autopilot_ttft_attainment']}",
+        file=sys.stderr, flush=True,
+    )
+
+
 def bench_disagg(results: Dict[str, Dict]) -> None:
     """Disaggregated prefill/decode serving (ISSUE 13): the
     long-prefill-interference experiment the architecture exists for.
@@ -1264,6 +1392,12 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         results["ingress_error"] = {"error": repr(e)}
         print(f"ingress bench failed: {e!r}", file=sys.stderr, flush=True)
+    print("== SLO autopilot benchmarks ==", file=sys.stderr, flush=True)
+    try:
+        _phase_trace("slo_autopilot", lambda: bench_slo_autopilot(results))
+    except Exception as e:  # noqa: BLE001
+        results["slo_autopilot_error"] = {"error": repr(e)}
+        print(f"slo autopilot bench failed: {e!r}", file=sys.stderr, flush=True)
     print("== disaggregated serving benchmarks ==", file=sys.stderr, flush=True)
     try:
         _phase_trace("disagg", lambda: bench_disagg(results))
@@ -1300,6 +1434,10 @@ def main() -> None:
     if ttft.get("value") is not None:
         runtime_ratios["serve_llm_ttft_p50_ms"] = ttft["value"]
         runtime_ratios["serve_llm_ttft_p99_ms"] = ttft.get("p99")
+    ap = results.get("slo_autopilot_ttft_attainment", {})
+    if ap.get("value") is not None:
+        runtime_ratios["slo_autopilot_ttft_attainment"] = ap["value"]
+        runtime_ratios["slo_autopilot_vs_static"] = ap.get("vs_static")
     for key, label in (
         ("pull_gbps_8mb", "pull_gbps_8mb"),
         ("pull_gbps_64mb", "pull_gbps_64mb"),

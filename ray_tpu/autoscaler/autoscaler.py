@@ -45,7 +45,9 @@ class StandardAutoscaler:
         self._backend = backend  # CoreWorker-ish (controller RPC access)
         self._idle_since: Dict[str, float] = {}  # provider node id -> ts
         self._stop = threading.Event()
+        self._kick = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_stats: Dict[str, Any] = {}
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -57,11 +59,31 @@ class StandardAutoscaler:
 
     def stop(self) -> None:
         self._stop.set()
+        self._kick.set()  # unblock the interval wait
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def kick(self) -> None:
+        """Run a reconcile pass NOW instead of at the next interval tick.
+
+        Demand-side controllers (e.g. the serve controller raising a
+        replica target on TTFT budget burn) call this so the node
+        reconciler's share of autoscaler lag is one pass, not up to a
+        full ``update_interval_s``."""
+        self._kick.set()
+
+    def stats(self) -> Dict[str, Any]:
+        """Summary of the most recent reconcile pass (empty before the
+        first): wall timestamp, pass duration, demand/unmet shape
+        counts, launches by node type, and idle terminations."""
+        return dict(self._last_stats)
+
     def _loop(self) -> None:
-        while not self._stop.wait(self._config.update_interval_s):
+        while True:
+            self._kick.wait(self._config.update_interval_s)
+            self._kick.clear()
+            if self._stop.is_set():
+                return
             try:
                 self.update()
             except Exception:  # noqa: BLE001 — keep reconciling
@@ -79,6 +101,7 @@ class StandardAutoscaler:
         )
 
     def update(self) -> None:
+        pass_t0 = time.monotonic()
         snap = self._demand()
         shapes: List[Dict[str, float]] = (
             list(snap["pending_tasks"])
@@ -190,6 +213,7 @@ class StandardAutoscaler:
         now = time.monotonic()
         node_rows = {n["node_id"]: n for n in snap["nodes"]}
         min_by_type = {t.name: t.min_workers for t in self._config.node_types}
+        terminated = 0
         for gid, members in groups.items():
             busy = bool(shapes)
             for rec in members:
@@ -215,8 +239,21 @@ class StandardAutoscaler:
             logger.info("scaling down: terminating idle slice %s", gid)
             counts[ntype] = counts.get(ntype, 1) - 1
             self._idle_since.pop(gid, None)
+            terminated += 1
             for rec in members:
                 self._provider.terminate_node(rec["id"])
+
+        launched_by_type: Dict[str, int] = {}
+        for nt in launches:
+            launched_by_type[nt.name] = launched_by_type.get(nt.name, 0) + 1
+        self._last_stats = {
+            "ts": time.time(),
+            "pass_duration_s": round(time.monotonic() - pass_t0, 6),
+            "demand_shapes": len(shapes),
+            "unmet_shapes": len(unmet),
+            "launches": launched_by_type,
+            "terminated_slices": terminated,
+        }
 
     def _pick_type(
         self, shape: Dict[str, float], counts: Dict[str, int], total_slices: int

@@ -364,6 +364,12 @@ class GlobalConfig:
     #: RNG seed for the replica fault plan; 0 = generate one (logged at
     #: activation for replay)
     testing_replica_chaos_seed: int = 0
+    #: MASTER chaos seed: when non-zero, every fault plan whose own seed
+    #: knob is 0 derives its seed deterministically from this one value
+    #: (util/chaos.py::derive_plan_seed — keyed blake2b of the plan
+    #: label), so a run arming all three plans reproduces from ONE
+    #: logged number instead of three. Explicit per-plan seeds still win.
+    testing_chaos_seed: int = 0
 
     def reset(self) -> None:
         for f in fields(self):

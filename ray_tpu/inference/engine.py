@@ -324,6 +324,16 @@ class InferenceEngine:
         #: fault paths are reconciled against
         self._books = {"submitted": 0, "finished": 0, "failed": 0, "cancelled": 0}
         self._token_times: deque = deque(maxlen=2048)
+        #: recent (monotonic, value) latency samples backing the gossiped
+        #: closed-loop signals (routing_stats ttft_p99_s / itl_p99_s).
+        #: The ledger tapes above are LIFETIME histograms — an autopilot
+        #: steering on them would barely feel current burn, so the
+        #: control signals come from a sliding window instead.
+        self._recent_ttfts: deque = deque(maxlen=512)
+        self._recent_itls: deque = deque(maxlen=2048)
+        #: (monotonic, n_tokens) per prefill pass — windowed prefill
+        #: throughput for the disagg pool-ratio adaptation
+        self._prefill_token_times: deque = deque(maxlen=2048)
         self._preempt_seen = 0
         self._replay_seen = 0
         self._prefix_seen: Dict[str, int] = {}
@@ -681,6 +691,8 @@ class InferenceEngine:
             logits = self.runner.decode(toks, poss, rows, cls)
             for req, lg in zip(plan.decodes, logits):
                 self._emit_token(req, self._sample(req, lg))
+        if n_prefill_tokens:
+            self._prefill_token_times.append((time.monotonic(), n_prefill_tokens))
         self.total_steps += 1
         timeline.record_event(
             "engine_step",
@@ -934,6 +946,7 @@ class InferenceEngine:
                 if sub is not None:
                     ttft = now - sub
                     self._ttft_tape.observe(ttft)
+                    self._recent_ttfts.append((now, ttft))
                     wire = self._trace_ctx.get(req.request_id)
                     if wire is not None:
                         first_span = (wire, ttft)
@@ -949,6 +962,7 @@ class InferenceEngine:
                 self.metrics["ttft"].observe(ttft, labels=slo_labels)
         elif req.last_emit_at is not None:
             gap = now - req.last_emit_at
+            self._recent_itls.append((now, gap))
             if gap > req.max_itl_s:
                 req.max_itl_s = gap
             if req.record_slo:
@@ -1135,6 +1149,29 @@ class InferenceEngine:
         span = max(now - tt[0], 1e-6)
         return len(tt) / span
 
+    @staticmethod
+    def _recent_quantile(samples: deque, q: float, window_s: float = 30.0) -> float:
+        """Quantile over the (ts, value) samples inside ``window_s`` —
+        the sliding-window control signal the autopilot steers on. 0.0
+        when the window is empty (callers treat that as "no signal").
+        Reads a list() copy: the reporter thread computes this while the
+        step thread appends."""
+        now = time.monotonic()
+        vals = sorted(v for ts, v in list(samples) if now - ts <= window_s)
+        if not vals:
+            return 0.0
+        idx = min(len(vals) - 1, max(0, int(math.ceil(q * len(vals))) - 1))
+        return vals[idx]
+
+    def _prefill_tokens_per_s(self, window_s: float = 10.0) -> float:
+        now = time.monotonic()
+        entries = [(ts, n) for ts, n in list(self._prefill_token_times)
+                   if now - ts <= window_s]
+        if not entries:
+            return 0.0
+        span = max(now - entries[0][0], 1e-6)
+        return sum(n for _ts, n in entries) / span
+
     def _ttft_quantiles(self) -> Dict[str, float]:
         """stats()/bench back-compat shape ({"p50", "p99"}), now derived
         from this engine's log-bucket TTFT tape instead of a sorted
@@ -1259,6 +1296,15 @@ class InferenceEngine:
             # without a replica round-trip per request)
             "max_queue_depth": self.engine_cfg.max_queue_depth,
             "total_admitted": self.scheduler.total_admitted,
+            # closed-loop control signals (serve/controller.py autopilot
+            # + ingress ITL-derived shed threshold + disagg pool-ratio
+            # adaptation): sliding-window latency quantiles and token
+            # throughput, NOT the lifetime ledger tapes — the autopilot
+            # must feel current burn, not the whole run's history
+            "ttft_p99_s": round(self._recent_quantile(self._recent_ttfts, 0.99), 6),
+            "itl_p99_s": round(self._recent_quantile(self._recent_itls, 0.99), 6),
+            "decode_tokens_per_s": round(self._tokens_per_s(), 2),
+            "prefill_tokens_per_s": round(self._prefill_tokens_per_s(), 2),
         }
 
     def healthy(self) -> bool:

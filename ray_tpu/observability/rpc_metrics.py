@@ -200,6 +200,16 @@ SERVE_REPLICA_RESTARTS = Counter(
     ("reason",),
 )
 
+#: autoscale target changes the controller actually APPLIED (post
+#: delay gating), by decision reason — queue_depth (legacy signal),
+#: ttft_burn / ttft_relax (SLO-autopilot budget burn), token_mix
+#: (disagg prefill:decode pool-ratio adaptation)
+SERVE_AUTOSCALE_DECISIONS = Counter(
+    "raytpu_serve_autoscale_decisions_total",
+    "serve autoscaler target changes applied, by deployment and reason",
+    ("deployment", "reason"),
+)
+
 # -- HTTP/SSE ingress (serve/ingress.py) ------------------------------------
 # The front door's overload behavior must be first-class telemetry: how
 # much traffic each tenant class brought and what happened to it, how

@@ -525,6 +525,11 @@ def build_report(
             if name in deployments:
                 deployments[name]["restarts"] = st.get("restarts")
                 deployments[name]["shed_total"] = st.get("shed_total")
+                # most recent applied autoscale decision (reason +
+                # old/new target + wall ts) so a report alone is enough
+                # to attribute autoscaler lag to a p99.9 miss window
+                if st.get("last_scale") is not None:
+                    deployments[name]["last_scale"] = st.get("last_scale")
 
     return {
         "generated_at": time.time(),

@@ -26,6 +26,76 @@ from ray_tpu.serve.replica import Replica
 CONTROLLER_NAME = "__serve_controller__"
 
 
+def autoscale_decision(
+    *,
+    target: int,
+    cfg: AutoscalingConfig,
+    total_load: float,
+    ttft_p99_s: float = 0.0,
+) -> Tuple[int, str]:
+    """Pure replica-count decision (cluster-free testable): what the
+    deployment's target should be, and why.
+
+    Legacy mode (no ``target_ttft_p99_s``, or no TTFT signal gossiped
+    yet): scale toward ``total_load / target_ongoing_requests`` —
+    unchanged queue-depth behavior.
+
+    SLO autopilot mode: burn = measured windowed TTFT-p99 / budget.
+      * burn >= ttft_burn_high — the budget is gone: scale OUT (at
+        least one step; straight to the queue-derived count when a
+        burst demands more).
+      * burn <= ttft_burn_low AND the queue signal agrees we're
+        over-provisioned: release ONE replica (conservative scale-in).
+      * in between — the hysteresis dead band: HOLD, so a chaos blip
+        (a replica kill inflating p99 for one window) doesn't thrash.
+    """
+    queue_desired = max(
+        cfg.min_replicas,
+        min(cfg.max_replicas, round(total_load / cfg.target_ongoing_requests)),
+    )
+    budget = cfg.target_ttft_p99_s
+    if not budget or ttft_p99_s <= 0.0:
+        return queue_desired, "queue_depth"
+    burn = ttft_p99_s / float(budget)
+    if burn >= cfg.ttft_burn_high:
+        return min(cfg.max_replicas, max(target + 1, queue_desired)), "ttft_burn"
+    if burn <= cfg.ttft_burn_low and queue_desired < target:
+        return max(cfg.min_replicas, target - 1), "ttft_relax"
+    return target, "hold"
+
+
+def pool_ratio_decision(
+    *,
+    prefill_target: int,
+    n_decode: int,
+    prefill_tokens_per_s: float,
+    decode_tokens_per_s: float,
+    min_replicas: int,
+    max_replicas: int,
+) -> Tuple[int, str]:
+    """Pure disagg prefill-pool sizing decision: with homogeneous
+    replicas, the prefill:decode split should track the observed
+    prefill:decode TOKEN mix (desired_prefill ≈ n_decode * P/D, both
+    rates from engine gossip). No signal on either side (idle pool,
+    gossip not landed) holds the current target — never resize blind."""
+    if prefill_tokens_per_s <= 0.0 or decode_tokens_per_s <= 0.0 or n_decode <= 0:
+        return prefill_target, "no_signal"
+    desired = int(round(n_decode * prefill_tokens_per_s / decode_tokens_per_s))
+    desired = max(min_replicas, min(max_replicas, max(1, desired)))
+    return desired, "token_mix"
+
+
+def _count_autoscale_decision(deployment: str, reason: str) -> None:
+    try:
+        from ray_tpu.observability.rpc_metrics import SERVE_AUTOSCALE_DECISIONS
+
+        SERVE_AUTOSCALE_DECISIONS.inc(
+            labels={"deployment": deployment, "reason": reason}
+        )
+    except Exception:
+        pass
+
+
 def _count_replica_restart(state: "_DeploymentState", reason: str) -> None:
     """A ready replica was killed for replacement: observed death or an
     unhealthy self-report. Counted on the controller's /metrics registry
@@ -78,6 +148,11 @@ class _DeploymentState:
         #: into status() so tests/operators see it without scraping the
         #: controller process's /metrics
         self.restarts: Dict[str, int] = {"death": 0, "unhealthy": 0}
+        #: last APPLIED autoscale decision ({"ts", "from", "to",
+        #: "reason"}) — surfaced via status() so the load harness can
+        #: measure autoscaler lag (burst start -> first target change)
+        #: without scraping metrics
+        self.last_scale_info: Dict[str, Any] = {}
 
 
 class _ServeController:
@@ -473,16 +548,25 @@ class _ServeController:
         queue_depth = 0
         outstanding = 0.0
         shed = 0
+        ttft = 0.0
+        itl = 0.0
         for stats, received in st.replica_stats.values():
             if now - received > ttl:
                 continue
             queue_depth += int(stats.get("queue_depth") or 0)
             outstanding += float(stats.get("outstanding_tokens") or 0.0)
             shed += int(stats.get("shed_total") or 0)
+            # worst fresh replica's windowed tail latencies — the same
+            # signals the autopilot steers on, surfaced for operators
+            # and the load harness
+            ttft = max(ttft, float(stats.get("ttft_p99_s", 0.0) or 0.0))
+            itl = max(itl, float(stats.get("itl_p99_s", 0.0) or 0.0))
         return {
             "queue_depth": queue_depth,
             "outstanding_tokens": round(outstanding, 1),
             "shed_total": shed,
+            "ttft_p99_s": round(ttft, 6),
+            "itl_p99_s": round(itl, 6),
         }
 
     def status(self) -> Dict[str, Dict[str, Any]]:
@@ -501,6 +585,7 @@ class _ServeController:
                     ),
                     "autoscaling": st.config.autoscaling is not None,
                     "restarts": dict(st.restarts),
+                    "last_scale": dict(st.last_scale_info),
                     **self._pressure_of(st),
                 }
                 for name, st in self._deployments.items()
@@ -818,11 +903,27 @@ class _ServeController:
     def _autoscale_once(self) -> None:
         now = time.monotonic()
         with self._lock:
-            states = [s for s in self._deployments.values() if s.config.autoscaling]
+            all_states = dict(self._deployments)
+        states = [s for s in all_states.values() if s.config.autoscaling]
+        # disagg prefill pools whose size the decode pool's token mix
+        # owns: the ratio decision replaces the queue/SLO decision there
+        # (both deployments must exist and the prefill one must opt in
+        # by carrying an autoscaling config)
+        paired_prefill = {
+            st.config.disagg_prefill: st.name
+            for st in all_states.values()
+            if st.config.disagg_prefill and st.config.disagg_prefill in all_states
+        }
         for st in states:
             cfg: AutoscalingConfig = st.config.autoscaling
+            if st.name in paired_prefill:
+                self._adapt_prefill_pool(
+                    st, all_states[paired_prefill[st.name]], cfg, now
+                )
+                continue
             total = 0.0
             n = 0
+            ttft = 0.0
             for _v, r in st.replicas:
                 try:
                     total += ray_tpu.get(r.stats.remote(), timeout=5)["ongoing"]
@@ -842,18 +943,94 @@ class _ServeController:
                     now - ent[1] < GLOBAL_CONFIG.serve_routing_stats_ttl_s
                 ):
                     total += float(ent[0].get("queue_depth", 0) or 0)
-            if n == 0:
+                    # SLO autopilot signal: the WORST fresh replica's
+                    # windowed TTFT p99 — a tail SLO is only as good as
+                    # the slowest replica serving it
+                    ttft = max(ttft, float(ent[0].get("ttft_p99_s", 0.0) or 0.0))
+            # the front door's client-observed first-byte p99 for THIS
+            # deployment (ingress replicas gossip target + ttfb_p99_s):
+            # the door's clock includes router-side waits — a replica
+            # death, dispatch queues — that the engines' own TTFT
+            # windows never contain, so a kill that stalls clients
+            # burns the budget even while every surviving engine's
+            # p99 looks healthy
+            for other in all_states.values():
+                for stats, received in other.replica_stats.values():
+                    if (
+                        stats.get("ingress")
+                        and stats.get("target") == st.name
+                        and now - received
+                        < GLOBAL_CONFIG.serve_routing_stats_ttl_s
+                    ):
+                        ttft = max(
+                            ttft, float(stats.get("ttfb_p99_s", 0.0) or 0.0)
+                        )
+            # no replica answered AND no door is watching: nothing to
+            # steer on. But every-replica-dead WITH a fresh ingress
+            # signal is exactly when budget burn must still scale out —
+            # the replacement logic restores count, the burn decision
+            # raises it
+            if n == 0 and ttft <= 0.0:
                 continue
-            desired = max(
-                cfg.min_replicas,
-                min(cfg.max_replicas, round(total / cfg.target_ongoing_requests)),
+            desired, reason = autoscale_decision(
+                target=st.target, cfg=cfg, total_load=total, ttft_p99_s=ttft
             )
-            delay = (
-                cfg.upscale_delay_s if desired > st.target else cfg.downscale_delay_s
+            self._apply_scale(st, cfg, desired, reason, now)
+
+    def _apply_scale(
+        self,
+        st: _DeploymentState,
+        cfg: AutoscalingConfig,
+        desired: int,
+        reason: str,
+        now: float,
+    ) -> None:
+        """Delay-gated target write shared by every autoscale path —
+        records the applied decision for status()/harness lag scoring."""
+        delay = (
+            cfg.upscale_delay_s if desired > st.target else cfg.downscale_delay_s
+        )
+        if desired != st.target and now - st.last_scale_ts >= delay:
+            prev = st.target
+            st.target = desired
+            st.last_scale_ts = now
+            st.last_scale_info = {
+                "ts": time.time(),
+                "from": prev,
+                "to": desired,
+                "reason": reason,
+            }
+            _count_autoscale_decision(st.name, reason)
+
+    def _adapt_prefill_pool(
+        self,
+        st: _DeploymentState,
+        decode_st: _DeploymentState,
+        cfg: AutoscalingConfig,
+        now: float,
+    ) -> None:
+        """Adapt a disagg prefill pool's size to the observed
+        prefill:decode token mix (both rates from fresh engine gossip —
+        prefill throughput reported by the prefill pool, decode
+        throughput by the decode pool)."""
+        ttl = GLOBAL_CONFIG.serve_routing_stats_ttl_s
+
+        def _rate(state: _DeploymentState, key: str) -> float:
+            return sum(
+                float(stats.get(key, 0.0) or 0.0)
+                for stats, received in state.replica_stats.values()
+                if now - received <= ttl
             )
-            if desired != st.target and now - st.last_scale_ts >= delay:
-                st.target = desired
-                st.last_scale_ts = now
+
+        desired, reason = pool_ratio_decision(
+            prefill_target=st.target,
+            n_decode=len(decode_st.replicas),
+            prefill_tokens_per_s=_rate(st, "prefill_tokens_per_s"),
+            decode_tokens_per_s=_rate(decode_st, "decode_tokens_per_s"),
+            min_replicas=cfg.min_replicas,
+            max_replicas=cfg.max_replicas,
+        )
+        self._apply_scale(st, cfg, desired, reason, now)
 
 
 ServeController = ray_tpu.remote(_ServeController)

@@ -56,8 +56,10 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
@@ -154,6 +156,13 @@ class IngressConfig:
     #: replacement replica — a restart no longer refills every tenant's
     #: budget. None (standalone/driver use) disables persistence.
     snapshot_key: Optional[str] = None
+    #: SLO autopilot: ITL p99 budget (seconds). When set, the load
+    #: watermark above stops being a static constant — it is scaled by
+    #: target/measured ITL (worst fresh replica's windowed p99, from
+    #: gossip), so the door tightens admission while decode steps are
+    #: slow and relaxes it when ITL runs comfortably under budget. See
+    #: ``effective_shed_threshold``. None = static watermark.
+    shed_itl_target_s: Optional[float] = None
 
     def resolved_rate(self, pol: TenantPolicy) -> float:
         if pol.rate is not None:
@@ -168,6 +177,32 @@ class IngressConfig:
         if self.default_burst is not None:
             return self.default_burst
         return GLOBAL_CONFIG.serve_ingress_default_burst
+
+
+#: bounds on the ITL-derived watermark adjustment: the closed loop may
+#: tighten the static base to 1/4 or relax it to 4x, never beyond —
+#: a latency spike (or an idle, instantly-fast engine) must not swing
+#: admission to zero or infinity on one gossip window
+ITL_ADJUST_MIN = 0.25
+ITL_ADJUST_MAX = 4.0
+
+
+def effective_shed_threshold(
+    base: float,
+    itl_target_s: Optional[float],
+    measured_itl_p99_s: float,
+) -> float:
+    """The load watermark the door actually applies, as a pure function
+    (cluster-free testable). Static ``base`` when no ITL target is
+    configured or no measurement has gossiped yet; otherwise the base
+    scaled by target/measured — at-budget ITL reproduces the static
+    threshold exactly, 2x-over-budget halves it, half-budget doubles it
+    — clamped to [ITL_ADJUST_MIN, ITL_ADJUST_MAX] times the base."""
+    if base <= 0 or not itl_target_s or measured_itl_p99_s <= 0.0:
+        return base
+    adjust = float(itl_target_s) / measured_itl_p99_s
+    adjust = max(ITL_ADJUST_MIN, min(ITL_ADJUST_MAX, adjust))
+    return base * adjust
 
 
 def shed_verdict(
@@ -190,7 +225,11 @@ def shed_verdict(
             frac >= cfg.shed_queue_fraction and priority < _TOP_PRIORITY
         ):
             return "queue_pressure"
-    base = cfg.shed_outstanding_per_replica
+    base = effective_shed_threshold(
+        cfg.shed_outstanding_per_replica,
+        cfg.shed_itl_target_s,
+        float(pressure.get("itl_p99_s") or 0.0),
+    )
     if base > 0:
         per_replica = float(pressure.get("outstanding_tokens") or 0.0) / reporting
         if per_replica > base * (priority + 1):
@@ -263,6 +302,20 @@ class HttpIngress:
         #: last flight-recorder shed entry per reason (1/s sampling —
         #: see _count_shed)
         self._shed_flight_at: Dict[str, float] = {}
+        #: (monotonic, ttfb_s) client-observed first-byte latencies —
+        #: the windowed p99 gossiped through routing_stats. The door's
+        #: clock includes router-side waits (replica death, dispatch
+        #: queues) that the engines' own TTFT windows never contain, so
+        #: the controller's SLO-autopilot burn signal for the TARGET
+        #: deployment reads it alongside the engines' gossip
+        self._recent_ttfb: deque = deque(maxlen=512)
+        #: forwarded requests still waiting for their FIRST byte
+        #: (request_id -> forward monotonic). Their current age is a
+        #: live lower bound on the eventual TTFB, folded into
+        #: ``_ttfb_p99`` — without it a total stall (every replica dead)
+        #: produces NO samples and the burn signal goes blind exactly
+        #: when it matters
+        self._inflight_t0: Dict[str, float] = {}
         self.host = host
         self.port = int(port)
         # dedicated pool for the blocking stream plumbing (dispatch +
@@ -561,9 +614,11 @@ class HttpIngress:
 
         with self._lock:
             self._forwarded += 1
+            self._inflight_t0[rid] = time.monotonic()
         try:
             values = await loop.run_in_executor(self._exec, _dispatch)
         except Exception as e:  # noqa: BLE001 — dispatch failed
+            self._inflight_t0.pop(rid, None)
             self._count(tenant_class, "error")
             return web.json_response({"error": repr(e)}, status=503)
 
@@ -592,6 +647,12 @@ class HttpIngress:
         request (cheap predicate per request; the joined record then
         shows whether the time went to the door, the router, or the
         engine)."""
+        with self._lock:
+            # first-byte-pending entry still here → the SSE first-byte
+            # hook never sampled this request (JSON path, or it died
+            # before any byte): record its TTFB now, exactly once
+            if self._inflight_t0.pop(rid, None) is not None:
+                self._recent_ttfb.append((time.monotonic(), float(ttfb_s)))
         slow = ttfb_s > GLOBAL_CONFIG.slo_ttft_slow_s
         if not slow and outcome == "ok":
             return
@@ -651,6 +712,11 @@ class HttpIngress:
                     first = False
                     first_dur = time.monotonic() - t0
                     ttfb.observe(first_dur)
+                    with self._lock:
+                        self._inflight_t0.pop(rid, None)
+                        self._recent_ttfb.append(
+                            (time.monotonic(), float(first_dur))
+                        )
                 await resp.write(f"data: {json.dumps(item)}\n\n".encode())
             await resp.write_eof()
         except (ConnectionError, asyncio.CancelledError):
@@ -679,16 +745,39 @@ class HttpIngress:
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
+    def _ttfb_p99(self, window_s: float = 30.0) -> float:
+        """Windowed client-observed first-byte p99. Requests still
+        WAITING for their first byte contribute their current age —
+        a live lower bound on their eventual TTFB — so a total stall
+        (every replica dead) registers immediately instead of only
+        after the stalled requests finally complete."""
+        now = time.monotonic()
+        with self._lock:
+            samples = list(self._recent_ttfb)
+            pending = [now - t0 for t0 in self._inflight_t0.values()]
+        vals = sorted(
+            [v for ts, v in samples if now - ts <= window_s] + pending
+        )
+        if not vals:
+            return 0.0
+        idx = min(len(vals) - 1, max(0, math.ceil(0.99 * len(vals)) - 1))
+        return vals[idx]
+
     def routing_stats(self) -> Dict[str, Any]:
         """Opts ingress replicas into the serve gossip reporter
         (serve/replica.py): the shed counter reaches ``serve.status()``
         through the same replica→controller channel the engines' queue
-        stats ride — no new control-plane path."""
+        stats ride — no new control-plane path. ``ttfb_p99_s`` +
+        ``target`` feed the controller's SLO-autopilot burn signal for
+        the target deployment (see controller._autoscale_once)."""
+        ttfb = self._ttfb_p99()
         with self._lock:
             return {
                 "shed_total": self._shed_total,
                 "forwarded_total": self._forwarded,
                 "ingress": True,
+                "target": self.cfg.target,
+                "ttfb_p99_s": round(ttfb, 6),
             }
 
     def ledger_books(self) -> Dict[str, Any]:

@@ -391,6 +391,8 @@ class Router:
         outstanding = 0.0
         max_queue = 0
         reporting = 0
+        itl = 0.0
+        ttft = 0.0
         for received, stats, _digest, _stamp in entries:
             if now - received > ttl:
                 continue
@@ -398,12 +400,20 @@ class Router:
             queue_depth += int(stats.get("queue_depth") or 0)
             outstanding += float(stats.get("outstanding_tokens") or 0.0)
             max_queue += int(stats.get("max_queue_depth") or 0)
+            # SLO autopilot signals: the WORST fresh replica's windowed
+            # tail latencies — the ingress derives its load watermark
+            # from measured ITL (effective_shed_threshold), and a tail
+            # SLO is only as good as the slowest replica serving it
+            itl = max(itl, float(stats.get("itl_p99_s", 0.0) or 0.0))
+            ttft = max(ttft, float(stats.get("ttft_p99_s", 0.0) or 0.0))
         return {
             "replicas": n,
             "reporting": reporting,
             "queue_depth": queue_depth,
             "outstanding_tokens": outstanding + local,
             "max_queue_depth": max_queue,
+            "itl_p99_s": itl,
+            "ttft_p99_s": ttft,
         }
 
     def _queue_len(self, replica) -> float:

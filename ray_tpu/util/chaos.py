@@ -15,6 +15,7 @@ pair scopes kills to ONE test cluster even with several running.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import random
 import signal
@@ -160,6 +161,18 @@ class DataFaultPlan:
         return hit
 
 
+def derive_plan_seed(master_seed: int, label: str) -> int:
+    """Per-plan seed derived from the MASTER chaos seed
+    (``RAY_TPU_testing_chaos_seed``): keyed blake2b of the plan label so
+    the three plans (rpc / pull / replica) get distinct but fully
+    deterministic streams from one logged number. Forced odd (never 0 —
+    0 means "generate" in the config grammar)."""
+    digest = hashlib.blake2b(
+        f"{int(master_seed)}:{label}".encode(), digest_size=4
+    ).digest()
+    return int.from_bytes(digest, "little") | 1
+
+
 class SeededPlanCache:
     """Process-wide lazy singleton for one env/config-driven seeded
     fault plan (the shared shape behind ``rpc.active_fault_plan``,
@@ -175,7 +188,7 @@ class SeededPlanCache:
         self._seed_attr = seed_attr
         self._logger = logger
         self._lock = threading.Lock()
-        self._key: Optional[Tuple[str, int]] = None
+        self._key: Optional[Tuple[str, int, int]] = None
         self._plan = None
 
     def active(self):
@@ -185,19 +198,30 @@ class SeededPlanCache:
         spec = getattr(GLOBAL_CONFIG, self._spec_attr)
         if not spec:
             return None
-        key = (spec, getattr(GLOBAL_CONFIG, self._seed_attr))
+        master = int(getattr(GLOBAL_CONFIG, "testing_chaos_seed", 0) or 0)
+        key = (spec, getattr(GLOBAL_CONFIG, self._seed_attr), master)
         if self._key == key:
             return self._plan
         with self._lock:
             if self._key == key:
                 return self._plan
-            seed = key[1] or (int.from_bytes(os.urandom(4), "little") | 1)
+            # explicit per-plan seed > master-derived > generated: an
+            # armed master seed makes the whole composite chaos run
+            # reproduce from ONE logged number
+            if key[1]:
+                seed, origin = key[1], ""
+            elif master:
+                seed = derive_plan_seed(master, self._label)
+                origin = f" [derived from RAY_TPU_testing_chaos_seed={master}]"
+            else:
+                seed = int.from_bytes(os.urandom(4), "little") | 1
+                origin = ""
             plan = self._plan_cls(spec, seed)
             self._logger.warning(
                 "%s chaos plan ACTIVE: spec=%r seed=%d "
-                "(reproduce: RAY_TPU_%s=%r RAY_TPU_%s=%d)",
+                "(reproduce: RAY_TPU_%s=%r RAY_TPU_%s=%d)%s",
                 self._label, spec, seed,
-                self._spec_attr, spec, self._seed_attr, seed,
+                self._spec_attr, spec, self._seed_attr, seed, origin,
             )
             self._plan, self._key = plan, key
             return plan

@@ -54,6 +54,15 @@ if not os.environ.get("RAY_TPU_testing_rpc_chaos_seed"):
         int.from_bytes(os.urandom(3), "little") | 1
     )
 
+# One MASTER chaos seed per session too (util/chaos.py::derive_plan_seed):
+# any fault plan armed without its own seed knob derives deterministically
+# from this value, so a multi-plan chaos failure replays from ONE number
+# instead of three. Explicit per-plan seeds (like the rpc one above) win.
+if not os.environ.get("RAY_TPU_testing_chaos_seed"):
+    os.environ["RAY_TPU_testing_chaos_seed"] = str(
+        int.from_bytes(os.urandom(3), "little") | 1
+    )
+
 import faulthandler  # noqa: E402
 
 import jax  # noqa: E402
@@ -116,6 +125,11 @@ def pytest_report_header(config):
         f"rpc chaos: seed={_CFG.testing_rpc_chaos_seed} plan={plan} — "
         "reproduce a chaos failure with "
         f"RAY_TPU_testing_rpc_chaos_seed={_CFG.testing_rpc_chaos_seed}"
+    )
+    lines.append(
+        f"master chaos seed: RAY_TPU_testing_chaos_seed="
+        f"{_CFG.testing_chaos_seed} (derives every plan seed not pinned "
+        "explicitly — one number replays the whole composite schedule)"
     )
     return lines
 
@@ -181,11 +195,40 @@ def _chaos_repro_line(nodeid: str):
             )
     if not entries:
         return None
+    # composite-chaos compression: per-plan seeds that are (or will be)
+    # DERIVED from the session's master seed collapse into the one
+    # master knob — a three-plan schedule replays from a single number
+    from ray_tpu.util.chaos import derive_plan_seed as _derive
+
+    _labels = {
+        "testing_rpc_chaos": "rpc",
+        "testing_pull_chaos": "pull",
+        "testing_replica_chaos": "replica",
+    }
+    try:
+        master = int(
+            os.environ.get("RAY_TPU_testing_chaos_seed")
+            or getattr(cfg, "testing_chaos_seed", 0)
+            or 0
+        )
+    except ValueError:
+        master = 0
     parts = []
+    master_covers = False
     for spec_key, (spec, seed_key, seed) in entries.items():
         parts.append(f"RAY_TPU_{spec_key}={spec!r}")
-        if seed:
-            parts.append(f"RAY_TPU_{seed_key}={seed}")
+        try:
+            seed_i = int(seed)
+        except (TypeError, ValueError):
+            seed_i = 0
+        if master and (
+            not seed_i or seed_i == _derive(master, _labels[spec_key])
+        ):
+            master_covers = True
+        elif seed_i:
+            parts.append(f"RAY_TPU_{seed_key}={seed_i}")
+    if master_covers:
+        parts.append(f"RAY_TPU_testing_chaos_seed={master}")
     return (
         " ".join(parts)
         + f" python -m pytest '{nodeid}'"

@@ -17,12 +17,14 @@ Layers under test:
 * router hardening — a gossip-capable deployment whose signals all went
   stale falls back with ``policy="stale_fallback"``, split from the
   plain pow-2 label;
-* the many-tenant chaos E2E — heavy-tailed tenants + one abusive tenant
-  + a seeded mid-run replica kill: the abusive tenant is shed (429s),
-  well-behaved tenants see ZERO client-visible errors and byte-exact
-  greedy streams (the PR 10 resumable path makes the kill invisible
-  through HTTP), and the run reproduces from the logged chaos env line
-  alone.
+* the loadgen harness E2E — a seeded :mod:`ray_tpu.serve.loadgen` trace
+  replayed through the real HTTP door, scored against the SLO ledger.
+
+The cluster tests here share ONE module-scoped cluster (they only need
+driver-side state; ``serve.shutdown()`` between tests resets the data
+plane). Tests that must stage env/config BEFORE ``ray_tpu.init`` — the
+chaos env plan and the bucket-snapshot period — live in
+``test_ingress_chaos.py`` with private per-test clusters.
 """
 
 import threading
@@ -65,6 +67,16 @@ _EC = dict(
     num_blocks=64, block_size=8, prefill_buckets=(8, 32),
     decode_buckets=(1, 8), max_decode_batch=8, max_new_tokens_default=8,
 )
+
+
+@pytest.fixture(scope="module")
+def ingress_cluster():
+    """One cluster for every serve-integration test in this module —
+    each test still deploys its own apps and tears them down with
+    ``serve.shutdown()``, but the runtime processes are shared."""
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
 
 
 # ---------------------------------------------------------------------------
@@ -157,7 +169,7 @@ def _run_llm_and_ingress(cfg, ing_cfg, *, llm_replicas=1, ing_replicas=1,
     return handle, serve.ingress_addresses(ing_name)
 
 
-def test_http_ingress_disconnect_shed_and_reconcile(cfg, params):
+def test_http_ingress_disconnect_shed_and_reconcile(cfg, params, ingress_cluster):
     """One cluster, three gates: (1) SSE streams are byte-exact vs a
     local reference engine; (2) a client disconnect mid-stream reaches
     engine.cancel() — blocks freed, total_admitted NOT re-counted; (3)
@@ -171,7 +183,6 @@ def test_http_ingress_disconnect_shed_and_reconcile(cfg, params):
             "vip": TenantPolicy(tenant_class="interactive"),
         },
     )
-    ray_tpu.init(num_cpus=4)
     try:
         handle, addrs = _run_llm_and_ingress(cfg, ing_cfg)
         addr = addrs[0]
@@ -291,10 +302,9 @@ def test_http_ingress_disconnect_shed_and_reconcile(cfg, params):
         assert sheds_rec, rep["flight_recorder"][:5]
     finally:
         serve.shutdown()
-        ray_tpu.shutdown()
 
 
-def test_queue_fraction_shed_spares_interactive(cfg, params):
+def test_queue_fraction_shed_spares_interactive(cfg, params, ingress_cluster):
     """Graceful degradation, deterministically: shed_queue_fraction=0.0
     sheds every below-top class the moment fresh engine gossip exists,
     while interactive traffic still flows — the priority ladder is
@@ -307,7 +317,6 @@ def test_queue_fraction_shed_spares_interactive(cfg, params):
             "vip": TenantPolicy(tenant_class="interactive"),
         },
     )
-    ray_tpu.init(num_cpus=4)
     try:
         _handle, addrs = _run_llm_and_ingress(cfg, ing_cfg, ing_name="ing")
         addr = addrs[0]
@@ -334,14 +343,13 @@ def test_queue_fraction_shed_spares_interactive(cfg, params):
         assert len(out) == 4
     finally:
         serve.shutdown()
-        ray_tpu.shutdown()
 
 
 # ---------------------------------------------------------------------------
 # router hardening: stale gossip falls back attributably
 
 
-def test_router_stale_gossip_counts_stale_fallback():
+def test_router_stale_gossip_counts_stale_fallback(ingress_cluster):
     """A gossip-capable deployment (no jax needed — any callable with
     routing_stats()) whose signals all age past the TTL must fall back
     to pow-2 under the DISTINCT policy label, so a load test can tell
@@ -349,7 +357,6 @@ def test_router_stale_gossip_counts_stale_fallback():
     from ray_tpu.core.config import GLOBAL_CONFIG
     from ray_tpu.observability.rpc_metrics import ROUTER_DECISIONS
 
-    ray_tpu.init(num_cpus=4)
     old_ttl = GLOBAL_CONFIG.serve_routing_stats_ttl_s
     try:
         @serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 0.1})
@@ -396,267 +403,68 @@ def test_router_stale_gossip_counts_stale_fallback():
     finally:
         GLOBAL_CONFIG.serve_routing_stats_ttl_s = old_ttl
         serve.shutdown()
-        ray_tpu.shutdown()
 
 
 # ---------------------------------------------------------------------------
-# the acceptance gate: many tenants + one abuser + seeded replica kill
+# the SLO-autopilot load harness, end to end through the real HTTP door
 
 
-@pytest.mark.chaos
-def test_e2e_many_tenant_chaos_slos_hold(cfg, params):
-    """ISSUE 12 gate: heavy-tailed tenants, one abusive tenant
-    saturating its bucket, TWO ingress doors over TWO engine replicas,
-    and a seeded ReplicaFaultPlan SIGKILLing engines mid-decode. The
-    abusive tenant is shed (429 + Retry-After); every well-behaved
-    request streams the byte-exact greedy sequence with ZERO
-    client-visible errors (the kill is absorbed by the resumable-stream
-    tier); shed requests never reached an engine (ingress-side
-    conservation); and the whole schedule reproduces from the chaos env
-    line the conftest repro helper prints."""
-    import os
-    import random
+def test_loadgen_trace_replays_through_ingress(cfg, params, ingress_cluster):
+    """A seeded :mod:`ray_tpu.serve.loadgen` trace replays through a
+    real ingress deployment with ZERO errors and scores against the SLO
+    ledger: the harness's client-side records reconcile with the door's
+    terminal outcomes, every record carries the request_id the trace
+    stamped (the flight-recorder join key), and the score block carries
+    attainment + the one-line repro."""
+    from ray_tpu.serve import loadgen
 
-    from ray_tpu.util.chaos import ReplicaFaultPlan
-
-    SPEC, SEED = "kill_mid_decode:1.0:25:1", 20260804
-    n_tenants, per_tenant, max_new = 4, 5, 6
-
-    # heavy-tailed prompt lengths (bounded Pareto), per-tenant shared
-    # system prefix so the affinity scorer has something to pin
-    rnd = random.Random(1234)
-    prefixes = {
-        t: [10 + t] * (8 + 2 * t) for t in range(n_tenants)
-    }
-    prompts = {}
-    for t in range(n_tenants):
-        for i in range(per_tenant):
-            tail_len = min(24, max(2, int(rnd.paretovariate(1.2))))
-            tail = [rnd.randrange(1, 250) for _ in range(tail_len)]
-            prompts[(t, i)] = prefixes[t] + tail
-
-    # expected sequences from an undisturbed local engine (greedy →
-    # deterministic continuation makes the killed-and-resumed streams
-    # byte-exact). Computed BEFORE the env plan is exported: see
-    # test_stream_resume for the self-SIGKILL rationale.
-    ref = InferenceEngine(cfg, params, EngineConfig(**_EC)).start()
-    try:
-        expected = {
-            k: list(ref.generate(p, max_new_tokens=max_new))
-            for k, p in prompts.items()
-        }
-    finally:
-        ref.stop()
-
-    os.environ["RAY_TPU_testing_replica_chaos"] = SPEC
-    os.environ["RAY_TPU_testing_replica_chaos_seed"] = str(SEED)
-    ray_tpu.init(num_cpus=4)
-    try:
-        # the conftest repro contract (same as PR 10's tests): a failure
-        # here prints ONE env line that replays this exact schedule
-        from conftest import _chaos_repro_line
-
-        line = _chaos_repro_line("tests/test_ingress.py::e2e")
-        assert line and SPEC in line and str(SEED) in line, line
-
-        ing_cfg = IngressConfig(
-            target="llm",
-            shed_outstanding_per_replica=2048.0,
-            tenants={
-                "abuser": TenantPolicy(
-                    rate=3.0, burst=40.0, tenant_class="batch"
-                ),
-                **{
-                    f"tenant-{t}": TenantPolicy(tenant_class="interactive")
-                    for t in range(n_tenants)
-                },
-            },
-        )
-        _handle, addrs = _run_llm_and_ingress(
-            cfg, ing_cfg, llm_replicas=2, ing_replicas=2, ing_name="ing",
-        )
-        ctrl = ray_tpu.get_actor("__serve_controller__")
-        ray_tpu.get(
-            ctrl.wait_status.remote("llm", min_replicas=2, timeout_s=90),
-            timeout=120,
-        )
-
-        results, errors, ttfts = {}, {}, []
-        shed_count, abuser_ok = [0], [0]
-        lock = threading.Lock()
-
-        def tenant_load(t):
-            tenant = f"tenant-{t}"
-            addr = pick_ingress(tenant, addrs)
-            for i in range(per_tenant):
-                key = (t, i)
-                try:
-                    t0 = time.monotonic()
-                    first, toks = None, []
-                    for tok in http_stream(
-                        addr,
-                        {"prompt": prompts[key], "max_new_tokens": max_new},
-                        tenant=tenant, connect_timeout=150.0,
-                    ):
-                        if first is None:
-                            first = time.monotonic() - t0
-                        toks.append(tok)
-                    with lock:
-                        results[key] = toks
-                        ttfts.append(first if first is not None else 0.0)
-                except Exception as e:  # noqa: BLE001
-                    with lock:
-                        errors[key] = e
-
-        def abuser_load():
-            addr = pick_ingress("abuser", addrs)
-            for _ in range(30):
-                try:
-                    list(http_stream(
-                        addr, {"prompt": [7, 7, 7, 7], "max_new_tokens": 8},
-                        tenant="abuser", connect_timeout=150.0,
-                    ))
-                    with lock:
-                        abuser_ok[0] += 1
-                except IngressShedError as e:
-                    assert e.retry_after > 0
-                    with lock:
-                        shed_count[0] += 1
-                time.sleep(0.05)
-
-        threads = [
-            threading.Thread(target=tenant_load, args=(t,))
-            for t in range(n_tenants)
-        ] + [threading.Thread(target=abuser_load)]
-        for th in threads:
-            th.start()
-        for th in threads:
-            th.join(timeout=150)
-        assert not any(th.is_alive() for th in threads), "load never finished"
-
-        # -- SLOs: zero client-visible errors, byte-exact streams
-        assert not errors, errors
-        bad = {k: (results[k], expected[k]) for k in expected
-               if results.get(k) != expected[k]}
-        assert not bad, bad
-        # bounded TTFT even across the kill (p99 over 20 streams = max)
-        assert max(ttfts) < 60.0, sorted(ttfts)[-3:]
-
-        # -- the abuser was actually shed, and sheds never reached an
-        # engine: at the door, requests either forwarded or 429'd
-        assert shed_count[0] > 0, (shed_count, abuser_ok)
-        replicas = ray_tpu.get(ctrl.get_replicas.remote("ing"), timeout=60)
-        dbg = [
-            ray_tpu.get(
-                r.handle_request.remote("debug_stats", [], {}, ""), timeout=60
-            )
-            for r in replicas
-        ]
-        total_ok = sum(
-            n for d in dbg for k, n in d["outcomes"].items()
-            if k.endswith(":ok")
-        )
-        total_shed = sum(d["shed_total"] for d in dbg)
-        forwarded = sum(d["forwarded_total"] for d in dbg)
-        n_requests = n_tenants * per_tenant + 30
-        assert total_ok + total_shed == n_requests, (dbg, n_requests)
-        assert forwarded == n_requests - total_shed, (forwarded, total_shed)
-        assert total_ok == n_tenants * per_tenant + abuser_ok[0]
-
-        # -- the kill provably landed mid-run and was absorbed: the
-        # ingress routers resumed streams, the controller replaced the
-        # dead engine replica(s)
-        resumes = sum(d["stream_resumes"].get("llm", 0) for d in dbg)
-        assert resumes > 0, dbg
-        st = ray_tpu.get(
-            ctrl.wait_status.remote("llm", min_replicas=2, timeout_s=120),
-            timeout=150,
-        )
-        assert st["replicas"] == 2 and st["restarts"]["death"] >= 1, st
-        # the scored (affinity) path engaged under load at the doors
-        affinity = sum(
-            d["router_decisions"].get("llm:affinity", 0) for d in dbg
-        )
-        assert affinity > 0, [d["router_decisions"] for d in dbg]
-
-        # -- reproducibility: the seeded schedule is a pure function of
-        # (seed, consult order) — the logged env line replays it
-        p1, p2 = ReplicaFaultPlan(SPEC, SEED), ReplicaFaultPlan(SPEC, SEED)
-        phases = ["prefill"] * 4 + ["decode"] * 30
-        s1 = [p1.consult(p) for p in phases]
-        assert s1 == [p2.consult(p) for p in phases]
-        assert p1.injections == 1
-    finally:
-        os.environ.pop("RAY_TPU_testing_replica_chaos", None)
-        os.environ.pop("RAY_TPU_testing_replica_chaos_seed", None)
-        from ray_tpu.core.config import GLOBAL_CONFIG
-
-        GLOBAL_CONFIG.testing_replica_chaos = ""
-        GLOBAL_CONFIG.testing_replica_chaos_seed = 0
-        serve.shutdown()
-        ray_tpu.shutdown()
-
-
-def test_bucket_state_survives_ingress_replica_restart(cfg, params):
-    """ISSUE 13 satellite: per-tenant token-bucket fill levels are
-    snapshot to the serve controller on a timer and restored by a
-    replacement replica — killing the door mid-depletion must NOT hand
-    the tenant a fresh burst. Pre-persistence, every restart reset every
-    tenant's budget (buckets were per-replica memory)."""
-    from ray_tpu.core.config import GLOBAL_CONFIG
-
-    # near-zero refill: any admission after the restart can only come
-    # from a (wrongly) refilled burst, never from honest refill. Burst
-    # covers exactly two requests of cost 4 + 8 = 12.
-    ing_cfg = IngressConfig(
-        target="llm",
-        tenants={"miser": TenantPolicy(rate=0.001, burst=24.0)},
+    spec = loadgen.LoadSpec(
+        seed=20260806,
+        duration_s=2.0,
+        base_rate_rps=5.0,
+        burst_factor=2.0,
+        n_tenants=3,
+        prompt_min=3,
+        prompt_max=12,
+        prefix_len=4,
+        output_min=2,
+        output_max=4,
     )
-    old_period = GLOBAL_CONFIG.serve_ingress_bucket_snapshot_period_s
-    GLOBAL_CONFIG.serve_ingress_bucket_snapshot_period_s = 0.25
-    ray_tpu.init(num_cpus=4)
+    trace = loadgen.build_trace(spec)
+    assert trace, "seed 20260806 must produce a non-empty 2s trace"
+    # the replay contract behind 'reproduces from one logged line'
+    assert [r.request_id for r in loadgen.build_trace(spec)] == [
+        r.request_id for r in trace
+    ]
+
+    ing_cfg = IngressConfig(target="llm", default_rate=1e6, default_burst=1e6)
     try:
         _handle, addrs = _run_llm_and_ingress(cfg, ing_cfg, ing_name="ing")
-        addr = addrs[0]
+        run = loadgen.run_trace(
+            trace,
+            spec=spec,
+            addresses=addrs,
+            time_scale=0.25,
+            timeout_s=60.0,
+            status_fn=serve.status,
+        )
+        assert len(run.records) == len(trace)
+        bad = [r for r in run.records if r["outcome"] != "ok"]
+        assert not bad, bad
 
-        def one(expect_ok: bool, a: str) -> bool:
-            try:
-                out = list(http_stream(
-                    a, {"prompt": [9, 2, 4, 6], "max_new_tokens": 8},
-                    tenant="miser", connect_timeout=120.0,
-                ))
-                assert len(out) == 8
-                return True
-            except IngressShedError as e:
-                assert e.reason == "rate_limit"
-                return False
-
-        # deplete the bucket: two admissions, third sheds
-        assert one(True, addr) is True
-        assert one(True, addr) is True
-        assert one(False, addr) is False
-        time.sleep(4 * GLOBAL_CONFIG.serve_ingress_bucket_snapshot_period_s)
-
-        # kill the door; the controller replaces it
-        ctrl = ray_tpu.get_actor("__serve_controller__")
-        victim = ray_tpu.get(ctrl.get_replicas.remote("ing"), timeout=30)[0]
-        ray_tpu.kill(victim)
-        deadline = time.monotonic() + 90
-        new_addr = None
-        while time.monotonic() < deadline:
-            try:
-                fresh = serve.ingress_addresses("ing", timeout=10)
-            except Exception:  # noqa: BLE001 — replacement still starting
-                fresh = []
-            if fresh and fresh[0] != addr:
-                new_addr = fresh[0]
-                break
-            time.sleep(0.5)
-        assert new_addr, "ingress replica was not replaced"
-
-        # the replacement restored the depleted bucket: still shed
-        assert one(False, new_addr) is False
+        s = loadgen.score(
+            run,
+            ttft_slo_s=30.0,
+            itl_slo_s=30.0,
+            report=serve.slo_report(),
+            status=serve.status(),
+        )
+        assert s["ok"] == len(trace) and s["errors"] == 0 and s["shed"] == 0
+        assert s["ttft_attainment"] == 1.0 and s["itl_attainment"] == 1.0
+        assert s["by_class"], s
+        assert f"LOADGEN_SEED={spec.seed}" in s["repro"]
+        assert s["miss_attribution"] == {}, s["miss_attribution"]
+        # the run sampled the live cluster status on a timer
+        assert run.samples and "llm" in run.samples[-1][1]
     finally:
-        GLOBAL_CONFIG.serve_ingress_bucket_snapshot_period_s = old_period
         serve.shutdown()
-        ray_tpu.shutdown()

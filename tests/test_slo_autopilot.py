@@ -1,0 +1,486 @@
+"""SLO autopilot: cluster-free decision-path + harness-determinism tests.
+
+Everything here runs without a cluster (ROADMAP CAUTION): the controller
+scale/pool decisions and the ingress shed threshold are pure functions,
+the load harness replays through an injected stream_fn, and the
+idle-cluster ``serve.slo_report()`` regression exercises the degraded
+driver-only path directly."""
+
+import logging
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.serve import loadgen
+from ray_tpu.serve.config import AutoscalingConfig
+from ray_tpu.serve.controller import autoscale_decision, pool_ratio_decision
+from ray_tpu.serve.ingress import (
+    ITL_ADJUST_MAX,
+    ITL_ADJUST_MIN,
+    IngressConfig,
+    IngressShedError,
+    effective_shed_threshold,
+    shed_verdict,
+)
+from ray_tpu.util.chaos import DataFaultPlan, SeededPlanCache, derive_plan_seed
+
+
+# ---------------------------------------------------------------------------
+# controller scale-out decision (TTFT budget burn + hysteresis)
+
+def _cfg(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 8)
+    kw.setdefault("target_ongoing_requests", 2.0)
+    return AutoscalingConfig(**kw)
+
+
+def test_autoscale_legacy_queue_path_without_slo_target():
+    cfg = _cfg()
+    desired, reason = autoscale_decision(
+        target=2, cfg=cfg, total_load=8.0, ttft_p99_s=0.0
+    )
+    assert (desired, reason) == (4, "queue_depth")
+    # an SLO target without any gossiped TTFT signal stays on the queue
+    # path too: never steer on a signal that hasn't landed
+    cfg = _cfg(target_ttft_p99_s=0.5)
+    desired, reason = autoscale_decision(
+        target=2, cfg=cfg, total_load=2.0, ttft_p99_s=0.0
+    )
+    assert (desired, reason) == (1, "queue_depth")
+
+
+def test_autoscale_burn_boundaries_and_hysteresis():
+    cfg = _cfg(target_ttft_p99_s=1.0, ttft_burn_high=1.0, ttft_burn_low=0.5)
+    # burn exactly AT the high threshold scales out (>=)
+    desired, reason = autoscale_decision(
+        target=2, cfg=cfg, total_load=0.0, ttft_p99_s=1.0
+    )
+    assert (desired, reason) == (3, "ttft_burn")
+    # a hair below: the dead band holds the target even though the
+    # queue signal alone would scale all the way down — chaos blips
+    # must not thrash replicas
+    desired, reason = autoscale_decision(
+        target=2, cfg=cfg, total_load=0.0, ttft_p99_s=0.999
+    )
+    assert (desired, reason) == (2, "hold")
+    # burn exactly AT the low threshold releases one replica (<=),
+    # but only when the queue signal agrees we're over-provisioned
+    desired, reason = autoscale_decision(
+        target=3, cfg=cfg, total_load=0.0, ttft_p99_s=0.5
+    )
+    assert (desired, reason) == (2, "ttft_relax")
+    desired, reason = autoscale_decision(
+        target=3, cfg=cfg, total_load=12.0, ttft_p99_s=0.5
+    )
+    assert (desired, reason) == (3, "hold")  # queue says keep them
+
+
+def test_autoscale_burn_respects_bounds_and_queue_jump():
+    cfg = _cfg(target_ttft_p99_s=0.1, max_replicas=4)
+    # at max: burn cannot push past max_replicas
+    desired, _ = autoscale_decision(
+        target=4, cfg=cfg, total_load=0.0, ttft_p99_s=5.0
+    )
+    assert desired == 4
+    # a burst whose queue-derived count exceeds target+1 jumps straight
+    # there — burn scale-out is at LEAST one step, not at most
+    desired, reason = autoscale_decision(
+        target=1, cfg=cfg, total_load=7.9, ttft_p99_s=5.0
+    )
+    assert (desired, reason) == (4, "ttft_burn")
+    # at min: relax cannot go below min_replicas
+    desired, _ = autoscale_decision(
+        target=1, cfg=_cfg(target_ttft_p99_s=1.0), total_load=0.0, ttft_p99_s=0.1
+    )
+    assert desired == 1
+
+
+# ---------------------------------------------------------------------------
+# disagg prefill:decode pool-ratio decision
+
+def test_pool_ratio_tracks_token_mix_and_clamps():
+    # equal rates, 4 decode replicas -> 4 prefill replicas
+    desired, reason = pool_ratio_decision(
+        prefill_target=1, n_decode=4, prefill_tokens_per_s=100.0,
+        decode_tokens_per_s=100.0, min_replicas=1, max_replicas=8,
+    )
+    assert (desired, reason) == (4, "token_mix")
+    # prefill-light mix shrinks the pool, clamped to min
+    desired, _ = pool_ratio_decision(
+        prefill_target=3, n_decode=4, prefill_tokens_per_s=1.0,
+        decode_tokens_per_s=1000.0, min_replicas=1, max_replicas=8,
+    )
+    assert desired == 1
+    # prefill-heavy mix grows it, clamped to max
+    desired, _ = pool_ratio_decision(
+        prefill_target=2, n_decode=4, prefill_tokens_per_s=1000.0,
+        decode_tokens_per_s=10.0, min_replicas=1, max_replicas=6,
+    )
+    assert desired == 6
+
+
+def test_pool_ratio_never_resizes_blind():
+    for pf, dec in ((0.0, 50.0), (50.0, 0.0), (0.0, 0.0)):
+        desired, reason = pool_ratio_decision(
+            prefill_target=3, n_decode=4, prefill_tokens_per_s=pf,
+            decode_tokens_per_s=dec, min_replicas=1, max_replicas=8,
+        )
+        assert (desired, reason) == (3, "no_signal")
+
+
+# ---------------------------------------------------------------------------
+# ingress ITL-derived shed threshold
+
+def test_effective_shed_threshold_static_without_target_or_signal():
+    assert effective_shed_threshold(2048.0, None, 0.7) == 2048.0
+    assert effective_shed_threshold(2048.0, 0.5, 0.0) == 2048.0
+    assert effective_shed_threshold(0.0, 0.5, 0.7) == 0.0  # disabled stays disabled
+
+
+def test_effective_shed_threshold_scales_with_measured_itl():
+    # at-budget ITL reproduces the static threshold exactly
+    assert effective_shed_threshold(1000.0, 0.5, 0.5) == pytest.approx(1000.0)
+    # 2x over budget halves admission; half-budget doubles it
+    assert effective_shed_threshold(1000.0, 0.5, 1.0) == pytest.approx(500.0)
+    assert effective_shed_threshold(1000.0, 0.5, 0.25) == pytest.approx(2000.0)
+    # clamped both ways
+    assert effective_shed_threshold(1000.0, 0.5, 1000.0) == pytest.approx(
+        1000.0 * ITL_ADJUST_MIN
+    )
+    assert effective_shed_threshold(1000.0, 0.5, 1e-6) == pytest.approx(
+        1000.0 * ITL_ADJUST_MAX
+    )
+
+
+def test_shed_verdict_uses_itl_derived_watermark():
+    cfg = IngressConfig(
+        shed_outstanding_per_replica=100.0,
+        shed_queue_fraction=1.0,
+        shed_itl_target_s=0.5,
+    )
+    pressure = {
+        "replicas": 1, "reporting": 1, "queue_depth": 0,
+        "max_queue_depth": 64, "outstanding_tokens": 80.0,
+    }
+    # no ITL signal: static 100-token watermark admits 80 outstanding
+    assert shed_verdict(dict(pressure), 0, cfg) is None
+    # measured ITL 2x over budget halves the watermark to 50: shed
+    pressure["itl_p99_s"] = 1.0
+    assert shed_verdict(dict(pressure), 0, cfg) == "load"
+    # higher classes keep their (k+1)x headroom over the derived base
+    assert shed_verdict(dict(pressure), 1, cfg) is None
+
+
+# ---------------------------------------------------------------------------
+# master chaos seed (one logged number replays the composite schedule)
+
+def test_derive_plan_seed_deterministic_distinct_nonzero():
+    assert derive_plan_seed(1234, "rpc") == derive_plan_seed(1234, "rpc")
+    labels = {derive_plan_seed(1234, lab) for lab in ("rpc", "pull", "replica")}
+    assert len(labels) == 3
+    for s in labels:
+        assert s % 2 == 1  # forced odd: never the "generate" sentinel 0
+    assert derive_plan_seed(1235, "rpc") != derive_plan_seed(1234, "rpc")
+
+
+def test_plan_cache_derives_seed_from_master():
+    old = (
+        GLOBAL_CONFIG.testing_pull_chaos,
+        GLOBAL_CONFIG.testing_pull_chaos_seed,
+        GLOBAL_CONFIG.testing_chaos_seed,
+    )
+    try:
+        GLOBAL_CONFIG.testing_pull_chaos = "chunk_drop:0.5"
+        GLOBAL_CONFIG.testing_pull_chaos_seed = 0
+        GLOBAL_CONFIG.testing_chaos_seed = 424242
+        cache = SeededPlanCache(
+            DataFaultPlan, "pull", "testing_pull_chaos",
+            "testing_pull_chaos_seed", logging.getLogger("test"),
+        )
+        plan = cache.active()
+        assert plan.seed == derive_plan_seed(424242, "pull")
+        # same master -> same plan seed -> identical injection schedule
+        twin = DataFaultPlan("chunk_drop:0.5", derive_plan_seed(424242, "pull"))
+        assert [plan.next_fault() for _ in range(32)] == [
+            twin.next_fault() for _ in range(32)
+        ]
+        # an EXPLICIT per-plan seed still wins over the master
+        GLOBAL_CONFIG.testing_pull_chaos_seed = 7
+        assert cache.active().seed == 7
+    finally:
+        (
+            GLOBAL_CONFIG.testing_pull_chaos,
+            GLOBAL_CONFIG.testing_pull_chaos_seed,
+            GLOBAL_CONFIG.testing_chaos_seed,
+        ) = old
+
+
+def test_loadgen_chaos_env_one_line():
+    spec = loadgen.LoadSpec(
+        seed=9, chaos_master_seed=777,
+        replica_chaos="kill_mid_decode:1.0:25:1", rpc_chaos="*:delay:0.1:0.05",
+    )
+    env = loadgen.chaos_env(spec)
+    assert env["RAY_TPU_testing_chaos_seed"] == "777"
+    assert env["RAY_TPU_testing_replica_chaos"] == "kill_mid_decode:1.0:25:1"
+    assert "RAY_TPU_testing_pull_chaos" not in env
+    line = loadgen.repro_line(spec)
+    assert "RAY_TPU_testing_chaos_seed=777" in line
+    assert "LOADGEN_SEED=9" in line
+
+
+# ---------------------------------------------------------------------------
+# trace harness: bit-replayable schedules + scoring
+
+def test_build_trace_bit_replayable():
+    spec = loadgen.LoadSpec(seed=31337, duration_s=4.0, base_rate_rps=12.0)
+    a = loadgen.build_trace(spec)
+    b = loadgen.build_trace(spec)
+    assert len(a) > 10
+    assert a == b  # same seed => identical arrivals, tenants, prompts
+    c = loadgen.build_trace(loadgen.LoadSpec(seed=31338, duration_s=4.0,
+                                             base_rate_rps=12.0))
+    assert a != c
+
+
+def test_build_trace_shapes_and_prefix_populations():
+    spec = loadgen.LoadSpec(seed=5, duration_s=6.0, base_rate_rps=15.0)
+    trace = loadgen.build_trace(spec)
+    ts = [r.t_s for r in trace]
+    assert ts == sorted(ts) and ts[-1] < spec.duration_s
+    classes = {r.tenant_class for r in trace}
+    assert classes <= {"interactive", "standard", "batch"}
+    for r in trace:
+        assert 1 <= len(r.prompt) <= spec.prompt_max + spec.prefix_len
+        assert spec.output_min <= r.max_new_tokens <= spec.output_max
+    # shared-prefix populations: reusing requests of one tenant lead
+    # with the SAME tokens (the radix-cache exercise)
+    by_tenant = {}
+    for r in trace:
+        by_tenant.setdefault(r.tenant, []).append(r)
+    shared = 0
+    for recs in by_tenant.values():
+        heads = {tuple(r.prompt[: spec.prefix_len]) for r in recs
+                 if len(r.prompt) > spec.prefix_len}
+        if len(heads) < sum(1 for r in recs if len(r.prompt) > spec.prefix_len):
+            shared += 1
+    assert shared > 0
+
+
+def test_run_trace_and_score_with_injected_stream():
+    spec = loadgen.LoadSpec(seed=2, duration_s=1.0, base_rate_rps=20.0)
+    trace = loadgen.build_trace(spec)
+    assert len(trace) >= 5
+
+    def stream_fn(req):
+        if req.index == 1:
+            raise RuntimeError("boom")
+        if req.index == 2:
+            raise IngressShedError("load", 0.25)
+        return iter([1, 2, 3])
+
+    run = loadgen.run_trace(
+        trace, spec=spec, stream_fn=stream_fn, time_scale=0.01, max_workers=8
+    )
+    assert len(run.records) == len(trace)
+    outcomes = {r["request_id"]: r["outcome"] for r in run.records}
+    assert outcomes[trace[1].request_id] == "error"
+    assert outcomes[trace[2].request_id] == "shed"
+    assert all(
+        r["n_tokens"] == 3 for r in run.records if r["outcome"] == "ok"
+    )
+    report = {
+        "flight_recorder": [
+            {"request_id": trace[1].request_id,
+             "slowest_stage": "router.dispatch", "flags": ["fault"]}
+        ],
+        "deployments": {"llm": {"goodput_fraction": 0.9}},
+    }
+    s = loadgen.score(
+        run, ttft_slo_s=10.0, itl_slo_s=1.0, report=report,
+        status={"llm": {"last_scale": {}}},
+    )
+    ok = s["ok"]
+    # the one error counts as a miss; sheds are excluded from the base
+    assert s["ttft_attainment"] == pytest.approx(ok / (ok + 1))
+    assert s["itl_attainment"] == 1.0
+    assert s["goodput_fraction"]["llm"] == 0.9
+    assert s["autoscaler_lag_s"] is None
+    attr = s["miss_attribution"]
+    assert attr[trace[1].request_id]["stage"] == "router.dispatch"
+    assert "LOADGEN_SEED=2" in s["repro"]
+
+
+def test_score_autoscaler_lag_from_last_scale_stamp():
+    run = loadgen.HarnessRun(
+        spec=loadgen.LoadSpec(seed=1),
+        records=[{"request_id": "r0", "tenant": "t", "tenant_class": "standard",
+                  "outcome": "ok", "ttft_s": 0.01, "e2e_s": 0.02,
+                  "n_tokens": 2, "itl_max_s": 0.01, "t_s": 0.0}],
+        itl_gaps=[0.01],
+        started_wall=1000.0,
+        duration_s=2.0,
+    )
+    status = {
+        "llm": {"last_scale": {"ts": 1001.5, "from": 1, "to": 2,
+                               "reason": "ttft_burn"}},
+        "ing": {"last_scale": {}},
+    }
+    s = loadgen.score(run, ttft_slo_s=1.0, status=status)
+    assert s["autoscaler_lag_s"] == pytest.approx(1.5)
+    # a scale-DOWN (or a pre-run scale) is not lag
+    status["llm"]["last_scale"] = {"ts": 1001.5, "from": 2, "to": 1}
+    assert loadgen.score(run, ttft_slo_s=1.0, status=status)[
+        "autoscaler_lag_s"
+    ] is None
+
+
+# ---------------------------------------------------------------------------
+# satellite fix: slo_report off-cluster / idle must degrade, not error
+
+def test_slo_report_without_cluster_is_wellformed_and_fast():
+    import time as _time
+
+    assert not ray_tpu.is_initialized()
+    t0 = _time.monotonic()
+    rep = serve.slo_report(timeout=5.0)
+    assert _time.monotonic() - t0 < 5.0  # degraded, under the deadline
+    assert set(rep) >= {"deployments", "counters", "flight_recorder", "buckets"}
+    # driver-only degraded report: well-formed dict/list shapes (the
+    # driver ledger is process-global, so contents may be non-empty when
+    # earlier tests in this pytest process exercised the serving path)
+    assert isinstance(rep["deployments"], dict)
+    assert isinstance(rep["flight_recorder"], list)
+
+
+# ---------------------------------------------------------------------------
+# ingress door: client-observed TTFB gossip (the burn signal's eyes on
+# router-side waits the engines' own TTFT clocks never contain)
+
+def test_ingress_door_gossips_windowed_ttfb_p99():
+    from ray_tpu.serve.ingress import HttpIngress
+
+    class _Handle:
+        _router = None
+
+    import time as _time
+
+    door = HttpIngress(IngressConfig(target="llm"), handle=_Handle())
+    try:
+        rs = door.routing_stats()
+        # no samples yet: 0.0 means "no signal", and the controller's
+        # burn path treats it as such (never steer blind)
+        assert rs["target"] == "llm" and rs["ttfb_p99_s"] == 0.0
+        for i, ttfb in enumerate((0.01, 0.02, 0.03, 1.5)):
+            # _flight_ttfb records the sample only for requests it saw
+            # forwarded (the in-flight entry is the once-only gate)
+            door._inflight_t0[f"r{i}"] = _time.monotonic()
+            door._flight_ttfb(f"r{i}", "standard", ttfb, "ok")
+        rs = door.routing_stats()
+        # p99 over a handful of samples is the max — the tail the burn
+        # signal must see
+        assert rs["ttfb_p99_s"] == pytest.approx(1.5)
+        assert rs["ingress"] is True
+        assert not door._inflight_t0  # every gate consumed exactly once
+        # a request STALLED waiting for its first byte contributes its
+        # current age live — the burn signal sees a dead-replica stall
+        # while it is happening, not after
+        door._inflight_t0["stuck"] = _time.monotonic() - 20.0
+        assert door._ttfb_p99() >= 20.0
+        door._inflight_t0.clear()
+        # duplicate terminal report for an already-sampled request is
+        # dropped, not double-counted
+        n_before = len(door._recent_ttfb)
+        door._flight_ttfb("r0", "standard", 9.9, "ok")
+        assert len(door._recent_ttfb) == n_before
+    finally:
+        door.stop()
+
+
+class _NullProvider:
+    """Provider double: empty fleet, records launch/terminate calls."""
+
+    def __init__(self):
+        self.created = []
+        self.terminated = []
+
+    def non_terminated_nodes(self):
+        return []
+
+    def create_node(self, node_type):
+        self.created.append(node_type.name)
+
+    def terminate_node(self, node_id):
+        self.terminated.append(node_id)
+
+
+def _node_autoscaler(demand, **cfg_kwargs):
+    from ray_tpu.autoscaler import (
+        AutoscalerConfig,
+        NodeTypeConfig,
+        StandardAutoscaler,
+    )
+
+    provider = _NullProvider()
+
+    class _Scaler(StandardAutoscaler):
+        def _demand(self):
+            return demand
+
+    cfg = AutoscalerConfig(
+        node_types=[NodeTypeConfig("worker", {"CPU": 4}, max_workers=4)],
+        **cfg_kwargs,
+    )
+    return _Scaler(provider, cfg), provider
+
+
+def test_node_autoscaler_stats_summarize_pass():
+    empty = {
+        "pending_tasks": [],
+        "pending_actors": [],
+        "pending_bundles": [],
+        "nodes": [],
+    }
+    scaler, provider = _node_autoscaler(empty)
+    assert scaler.stats() == {}  # nothing before the first pass
+    scaler.update()
+    st = scaler.stats()
+    assert st["demand_shapes"] == 0 and st["unmet_shapes"] == 0
+    assert st["launches"] == {} and st["terminated_slices"] == 0
+    assert st["pass_duration_s"] >= 0.0 and st["ts"] > 0
+
+    busy = dict(empty, pending_actors=[{"CPU": 4}])
+    scaler2, provider2 = _node_autoscaler(busy)
+    scaler2.update()
+    st2 = scaler2.stats()
+    assert st2["demand_shapes"] == 1 and st2["unmet_shapes"] == 1
+    assert st2["launches"] == {"worker": 1}
+    assert provider2.created == ["worker"]
+
+
+def test_node_autoscaler_kick_skips_the_interval_wait():
+    import time as _time
+
+    empty = {
+        "pending_tasks": [],
+        "pending_actors": [],
+        "pending_bundles": [],
+        "nodes": [],
+    }
+    # interval so long that only kick() can trigger a pass in-test
+    scaler, _provider = _node_autoscaler(empty, update_interval_s=60.0)
+    scaler.start()
+    try:
+        assert scaler.stats() == {}
+        scaler.kick()
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline and not scaler.stats():
+            _time.sleep(0.02)
+        assert scaler.stats(), "kick() did not trigger a reconcile pass"
+    finally:
+        t0 = _time.monotonic()
+        scaler.stop()  # must unblock the 60s wait immediately
+        assert _time.monotonic() - t0 < 5.0
