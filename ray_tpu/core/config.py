@@ -265,6 +265,32 @@ class GlobalConfig:
     #: mode / unit tests) — bigger exports fail → plain generation
     kv_inline_max_bytes: int = 32 * 1024**2
 
+    # --- cluster-wide KV prefix tier (inference/kv_transfer.py + node_daemon) ---
+    #: cap on tier-resident prefix digests a replica advertises through
+    #: the routing-stats gossip (MRU subset; the daemon registry can
+    #: hold more — adverts are the routable window, not the inventory)
+    kv_tier_max_adverts: int = 32
+    #: daemon-side tier registry TTL: blocks nobody faulted in for this
+    #: long are dropped (and their shm objects deleted). The tier is a
+    #: cache, not a durable store.
+    kv_tier_ttl_s: float = 600.0
+    #: entry cap per daemon tier registry; oldest-first eviction with
+    #: object deletion. Bounds shm spent on spilled KV.
+    kv_tier_max_entries: int = 512
+    #: how long a router keeps tier directory entries sourced from a
+    #: DEAD replica before expiring them (the daemon still holds the
+    #: bytes — a replacement replica re-adverts within one gossip beat,
+    #: so this is the warm-restart bridge window). Explicit retraction
+    #: by a LIVE holder purges immediately, not on this TTL.
+    kv_tier_advert_ttl_s: float = 30.0
+    #: explicit tier namespace override. The daemon tier registry is
+    #: node-global and the chain digest names only the TOKENS, so tier
+    #: keys are scoped by a model-identity namespace (config + weight
+    #: fingerprint, derived per engine) — two deployments of the same
+    #: architecture with different weights can never serve each other's
+    #: KV. Set this to force a shared (or extra-isolated) namespace.
+    kv_tier_namespace: str = ""
+
     # --- serve ingress (serve/ingress.py: the HTTP/SSE front door) ---
     #: per-request deadline when the client sends none (header
     #: x-request-timeout-s / body timeout_s override, clamped to this as
@@ -364,6 +390,15 @@ class GlobalConfig:
     #: RNG seed for the replica fault plan; 0 = generate one (logged at
     #: activation for replay)
     testing_replica_chaos_seed: int = 0
+    #: seeded KV-TIER fault plan consulted by the tier fault-in path
+    #: once per phase execution: "mode:prob[:param][:max],..." with mode
+    #: in {missing_block, corrupt_block, stale_advert,
+    #: kill_mid_migration} — see util/chaos.py::KvTierFaultPlan (same
+    #: determinism contract as ReplicaFaultPlan). Empty = no injection.
+    testing_kv_tier_chaos: str = ""
+    #: RNG seed for the KV-tier fault plan; 0 = generate one (logged at
+    #: activation for replay)
+    testing_kv_tier_chaos_seed: int = 0
     #: MASTER chaos seed: when non-zero, every fault plan whose own seed
     #: knob is 0 derives its seed deterministically from this one value
     #: (util/chaos.py::derive_plan_seed — keyed blake2b of the plan

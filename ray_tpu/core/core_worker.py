@@ -159,6 +159,10 @@ class CoreWorker(RuntimeBackend):
         self._streams_lock = threading.Lock()
         # node membership/drain event listeners (Train drain watch etc.)
         self._node_event_listeners: List[Any] = []
+        # nodes the controller has pushed as dead: fetches skip these
+        # sources and go straight to the relocation directory instead of
+        # burning the chunk-retry ladder against a corpse
+        self._dead_nodes: set = set()
         # borrowed refs observed ready via a status RPC: lets a
         # wait(timeout=0) poll answer from cache instead of paying the
         # borrowed-status grace window every call (bounded FIFO)
@@ -539,26 +543,43 @@ class CoreWorker(RuntimeBackend):
         else:
             meta = None
         failure = None
+        skipped_dead_sources = False
         if meta is None:
             sources = [(h, p) for (_nid, h, p) in locations if _nid != self.node_id]
-            # the pull inherits this get()'s remaining budget (nested gets
-            # propagate deadlines through the whole fetch path — a
-            # hard-coded 300 here used to quietly extend the caller's)
-            budget = effective_timeout(300.0)
-            reply = await self.daemon.call(
-                "pull_object",
-                {"object_id": oid.binary(), "sources": sources, "deadline_s": budget},
-                timeout=budget,
-            )
-            meta, failure = self._parse_pull_reply(reply)
-            if meta is None and failure.get("deadline"):
-                # the transfer ran out of THIS caller's budget, with live
-                # sources: that is a timeout, not object loss — lineage
-                # reconstruction / relocation fallback would be wrong
-                raise GetTimeoutError(
-                    f"fetch of {oid.hex()[:12]} ran out of budget mid-transfer "
-                    f"({failure.get('causes')})"
+            live = [
+                (h, p)
+                for (_nid, h, p) in locations
+                if _nid != self.node_id and _nid not in self._dead_nodes
+            ]
+            skipped_dead_sources = len(live) < len(sources)
+            if sources and not live:
+                # every remote holder is controller-confirmed DEAD: a pull
+                # would only burn its chunk-retry ladder against corpses.
+                # Skip straight to the relocation consult (drained nodes
+                # replicate primaries away before exiting); if the
+                # directory has nothing we still try the stale sources
+                # below, so a spurious dead-marking can't lose an object.
+                failure = {"failed": True, "no_source": True, "causes": {}}
+            else:
+                # the pull inherits this get()'s remaining budget (nested
+                # gets propagate deadlines through the whole fetch path —
+                # a hard-coded 300 here used to quietly extend the caller's)
+                budget = effective_timeout(300.0)
+                reply = await self.daemon.call(
+                    "pull_object",
+                    {"object_id": oid.binary(), "sources": live, "deadline_s": budget},
+                    timeout=budget,
                 )
+                meta, failure = self._parse_pull_reply(reply)
+                if meta is None and failure.get("deadline"):
+                    # the transfer ran out of THIS caller's budget, with
+                    # live sources: that is a timeout, not object loss —
+                    # lineage reconstruction / relocation fallback would
+                    # be wrong
+                    raise GetTimeoutError(
+                        f"fetch of {oid.hex()[:12]} ran out of budget "
+                        f"mid-transfer ({failure.get('causes')})"
+                    )
         if meta is None:
             # Stale locations can mean the holding node DRAINED and
             # replicated its copies away — consult the controller's
@@ -568,6 +589,28 @@ class CoreWorker(RuntimeBackend):
             moved = await self._fetch_relocated(oid)
             if moved is not None:
                 meta = moved
+        if meta is None and skipped_dead_sources:
+            # relocation directory had nothing and we never actually tried
+            # the (dead-marked) sources: try them now rather than declare
+            # loss on the strength of a push alone
+            budget = effective_timeout(300.0)
+            reply = await self.daemon.call(
+                "pull_object",
+                {
+                    "object_id": oid.binary(),
+                    "sources": [
+                        (h, p) for (_nid, h, p) in locations if _nid != self.node_id
+                    ],
+                    "deadline_s": budget,
+                },
+                timeout=budget,
+            )
+            meta, failure = self._parse_pull_reply(reply)
+            if meta is None and failure.get("deadline"):
+                raise GetTimeoutError(
+                    f"fetch of {oid.hex()[:12]} ran out of budget mid-transfer "
+                    f"({failure.get('causes')})"
+                )
         if meta is None:
             # ONE owner-side line for the whole fetch attempt: the
             # structured causes say which sources were missing the object
@@ -1531,6 +1574,12 @@ class CoreWorker(RuntimeBackend):
         """Controller-pushed node membership/state changes. Libraries
         (Train's drain watch, Serve) register listeners to react to
         DRAINING the moment the warning lands, not on a poll interval."""
+        nid = msg.get("node_id")
+        if nid is not None:
+            if msg.get("alive"):
+                self._dead_nodes.discard(nid)
+            elif msg.get("state") == "DEAD" or msg.get("alive") is False:
+                self._dead_nodes.add(nid)
         for cb in list(self._node_event_listeners):
             try:
                 cb(msg)

@@ -28,6 +28,7 @@ import os
 import subprocess
 import sys
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -163,6 +164,14 @@ class NodeDaemon:
         # them REPLAYED after re-registration or owners mid-fetch would
         # fall back to lineage reconstruction (bounded ring)
         self._reported_moves: List[Dict[str, Any]] = []
+        # cluster KV-tier registry: chain-digest hex -> {"desc", "expiry"}
+        # (oldest-put first; refreshed to MRU on every get). The DAEMON
+        # owns tier entries — they survive the replica process that
+        # published them, which is the whole warm-restart story; TTL/cap
+        # eviction (and the object delete that goes with it) runs in
+        # _reap_loop.
+        self._kv_tier: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._last_kv_tier_sweep = 0.0
         # drain protocol state (graceful preemption; see drain())
         self._draining = False
         self._drain_task: Optional[asyncio.Task] = None
@@ -811,6 +820,7 @@ class NodeDaemon:
                         pass
             self._kill_idle_workers()
             self._sweep_orphan_pools()
+            self._kv_tier_sweep()
             now = time.monotonic()
             if now - self._last_oom_check >= GLOBAL_CONFIG.memory_monitor_period_s:
                 self._last_oom_check = now
@@ -1384,6 +1394,72 @@ class NodeDaemon:
             # receive reuse pool instead of being unlinked
             recycle_receive=bool(payload.get("recycle_receive")),
         )
+
+    # ---- cluster KV-tier registry (PR 17) ------------------------------
+    def _kv_tier_drop_locked(self, digest: str) -> None:
+        """Remove one tier entry and its store object (best-effort: the
+        object may already be gone if a reader raced a delete)."""
+        ent = self._kv_tier.pop(digest, None)
+        if ent is None:
+            return
+        oid_hex = (ent.get("desc") or {}).get("object_id")
+        if oid_hex:
+            try:
+                self.store.delete(ObjectID(bytes.fromhex(oid_hex)))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _kv_tier_sweep(self) -> None:
+        """TTL + cap eviction for tier entries (called from _reap_loop).
+        The tier is a cache: entries nobody faulted in for
+        kv_tier_ttl_s, or beyond kv_tier_max_entries (oldest-use first),
+        are dropped with their objects."""
+        now = time.monotonic()
+        if now - self._last_kv_tier_sweep < 1.0:
+            return
+        self._last_kv_tier_sweep = now
+        for digest in [
+            d for d, ent in self._kv_tier.items() if now > ent["expiry"]
+        ]:
+            self._kv_tier_drop_locked(digest)
+        cap = max(1, GLOBAL_CONFIG.kv_tier_max_entries)
+        while len(self._kv_tier) > cap:
+            self._kv_tier_drop_locked(next(iter(self._kv_tier)))
+
+    async def d_kv_tier_put(self, payload, conn):
+        """Register one tier entry (the object itself was already
+        published + adopted through the normal store path — this call
+        transfers LIFETIME ownership to the daemon's registry)."""
+        digest = str(payload["digest"])
+        self._kv_tier[digest] = {
+            "desc": payload["desc"],
+            "expiry": time.monotonic() + GLOBAL_CONFIG.kv_tier_ttl_s,
+        }
+        self._kv_tier.move_to_end(digest)
+        self._kv_tier_sweep()
+        return True
+
+    async def d_kv_tier_get(self, payload, conn):
+        """Lookup one entry; a hit refreshes TTL + recency (a faulted-in
+        prefix is by definition still hot)."""
+        ent = self._kv_tier.get(str(payload["digest"]))
+        if ent is None:
+            return None
+        ent["expiry"] = time.monotonic() + GLOBAL_CONFIG.kv_tier_ttl_s
+        self._kv_tier.move_to_end(str(payload["digest"]))
+        return ent["desc"]
+
+    async def d_kv_tier_del(self, payload, conn):
+        self._kv_tier_drop_locked(str(payload["digest"]))
+        return True
+
+    async def d_kv_tier_list(self, payload, conn):
+        """Full registry dump — the warm-restart recovery read: a
+        replacement replica booting on this node re-adverts every
+        surviving entry within one gossip beat."""
+        return {
+            "entries": {d: ent["desc"] for d, ent in self._kv_tier.items()}
+        }
 
     def _peer(self, host: str, port: int) -> RpcClient:
         key = (host, port)

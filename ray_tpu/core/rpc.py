@@ -145,6 +145,10 @@ IDEMPOTENT_METHODS: Dict[str, frozenset] = {
             # idempotent-by-construction object/worker ops
             "pull_object", "adopt_object", "delete_object",
             "kill_worker", "return_lease",
+            # KV-tier registry: get/list are pure reads; put/del are
+            # last-write-wins upserts/deletes keyed by content digest,
+            # so a blind retry converges to the same registry state
+            "kv_tier_get", "kv_tier_list", "kv_tier_put", "kv_tier_del",
             # idempotently guarded (per-worker released-state latch):
             # blind retries re-observe, never double-release
             "worker_blocked", "worker_unblocked",

@@ -44,7 +44,7 @@ import signal
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
@@ -52,7 +52,7 @@ import numpy as np
 
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.deadline import Deadline, remaining as deadline_remaining
-from ray_tpu.inference.kv_cache import PagedBlockManager
+from ray_tpu.inference.kv_cache import PagedBlockManager, _chain_digest
 from ray_tpu.inference.scheduler import (
     CANCELLED,
     DECODE,
@@ -91,6 +91,35 @@ def active_replica_fault_plan():
     return _RPLAN_CACHE.active()
 
 
+def _model_kv_namespace(model_cfg, params) -> str:
+    """Model-identity namespace for cluster KV tier keys. A chain
+    digest names a TOKEN prefix, not the model that computed the KV —
+    and the daemon tier registry is node-global — so tier keys are
+    scoped by a fingerprint of (config, weights): the model config's
+    repr plus, per weight leaf, its path, shape, dtype and a
+    first-elements value sample. Two deployments of the same
+    architecture with different weights therefore can never serve each
+    other's KV (their shapes/dtypes are identical — only the values
+    differ, which is exactly what the sample catches). Replicas of ONE
+    deployment agree because param init is bit-deterministic (fixed
+    seed; PR 14) and checkpoint loads share bytes."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(repr(model_cfg).encode())
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        for path, leaf in sorted(leaves, key=lambda kv: str(kv[0])):
+            sample = np.ascontiguousarray(np.asarray(leaf.reshape(-1)[:4]))
+            h.update(str(path).encode())
+            h.update(str(np.shape(leaf)).encode())
+            h.update(str(sample.dtype).encode())
+            h.update(sample.tobytes())
+    except Exception:  # noqa: BLE001 — the config repr alone still scopes
+        pass
+    return h.hexdigest()
+
+
 def _stable_request_seed(request_id: str) -> int:
     """Process-independent sampling seed derived from a request id.
     ``hash()`` is salted per interpreter (PYTHONHASHSEED), which would
@@ -107,6 +136,21 @@ class EngineDrainingError(RuntimeError):
 
 class RequestFailedError(RuntimeError):
     """The engine gave up on a request (deadline expiry, drain cutoff)."""
+
+
+#: string marker the serve router's resumable-stream failover matches on
+#: (the exception type itself may be re-raised under a different class
+#: after crossing the actor boundary — the message survives any wrapper);
+#: defined in jax-free kv_transfer so routers can match it without
+#: importing the engine
+from ray_tpu.inference.kv_transfer import KV_MIGRATION_MARKER  # noqa: E402
+
+
+class KvMigrationHandoff(RequestFailedError):
+    """A draining replica flushed this in-flight request's FULL KV
+    (prompt + generated) into the cluster tier and handed the stream
+    back: the router resumes it on a survivor, which faults the KV in
+    instead of re-prefilling — client-invisible through the SeqGate."""
 
 
 @dataclass
@@ -163,6 +207,15 @@ class EngineConfig:
     #: request modes (prefill_kv / import_kv_blocks). Off by default so
     #: plain deployments keep their exact compile count.
     kv_transfer_enabled: bool = False
+    #: cluster-wide KV prefix tier (kv_transfer.py tier layer): write
+    #: popular full prefix blocks back into daemon-owned shm storage
+    #: (explicitly at prefill/decode block boundaries, and as the SPILL
+    #: half of the eviction spill-vs-drop policy), advertise them
+    #: through the routing gossip, and serve warm recovery — resume via
+    #: fault-in, warm replica restart, drain-time live migration.
+    #: Implies the gather/scatter programs (kv_transfer warmup). Off by
+    #: default so plain deployments keep their exact compile count.
+    kv_tier_enabled: bool = False
 
     def resolved_prefill_buckets(self, max_seq_len: int) -> Sequence[int]:
         if self.prefill_buckets is not None:
@@ -261,6 +314,16 @@ class InferenceEngine:
                 f"decode bucket {max(decode_buckets)}; add a bucket >= the "
                 "batch cap or lower max_decode_batch"
             )
+        #: model-identity namespace scoping this engine's tier keys
+        #: (REVIEW: the digest names tokens, the daemon registry is
+        #: node-global — unscoped, one model could serve another's KV).
+        #: Computed BEFORE runner construction: donation may invalidate
+        #: the params tree the fingerprint samples.
+        self._tier_ns = ""
+        if ec.kv_tier_enabled:
+            self._tier_ns = GLOBAL_CONFIG.kv_tier_namespace or _model_kv_namespace(
+                model_cfg, params
+            )
         self.runner = PagedModelRunner(
             model_cfg,
             params,
@@ -342,9 +405,39 @@ class InferenceEngine:
         #: loop's own cache swaps (donation on TPU invalidates the buffer
         #: a concurrent reader grabbed)
         self._kv_imports: "queue.Queue" = queue.Queue()
+        # -- cluster KV tier (PR 17) --
+        #: tier adverts this replica gossips: digest hex -> routable
+        #: descriptor, MRU-capped at kv_tier_max_adverts. Dropping an
+        #: entry here IS the retraction signal — routers diff advert
+        #: sets per report and purge in one gossip hop.
+        self._tier_adverts: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        #: guards _tier_adverts and _tier_pending: mutated by the step
+        #: thread AND the tier publisher thread, snapshotted by
+        #: routing_stats on the actor thread — an unlocked OrderedDict
+        #: move_to_end/popitem races "mutated during iteration" there
+        self._tier_lock = threading.Lock()
+        #: digests queued for background publish (dedup vs re-enqueue)
+        self._tier_pending: set = set()
+        #: (digest, host kv, trigger) handed to the tier publisher
+        #: thread. Gathers stay ON the step thread (device cache reads
+        #: must not race donation) but the publish — shm write + daemon
+        #: RPC with a 10s timeout — must come OFF it: a wedged daemon
+        #: would otherwise stall token emission for the whole batch at
+        #: every block boundary. Bounded: overflow drops the write-back
+        #: (best-effort warmth, never backpressure on decode).
+        self._tier_pub_q: "queue.Queue" = queue.Queue(maxsize=256)
+        self._tier_pub_thread: Optional[threading.Thread] = None
+        #: (digest, host kv) spills gathered under the block-manager
+        #: lock, published by the step thread OUTSIDE it (publish does
+        #: shm writes + daemon RPC — too heavy for an allocation path)
+        self._tier_spill_pending: List[tuple] = []
+        #: drain-with-migration latch (begin_drain(migrate=True))
+        self._migrate_on_drain = False
+        if ec.kv_tier_enabled:
+            self.blocks.set_spill_hook(self._tier_spill)
         self.total_steps = 0
         if ec.warmup:
-            self.runner.warmup(kv_io=ec.kv_transfer_enabled)
+            self.runner.warmup(kv_io=ec.kv_transfer_enabled or ec.kv_tier_enabled)
         else:
             self.runner.mark_warm()
 
@@ -356,13 +449,46 @@ class InferenceEngine:
                 target=self._loop, daemon=True, name="llm-engine-step"
             )
             self._thread.start()
+        if self.engine_cfg.kv_tier_enabled:
+            if self._tier_pub_thread is None or not self._tier_pub_thread.is_alive():
+                self._tier_pub_thread = threading.Thread(
+                    target=self._tier_publish_loop,
+                    daemon=True,
+                    name="llm-engine-tier-pub",
+                )
+                self._tier_pub_thread.start()
+            self._tier_recover()
         return self
+
+    def _tier_recover(self) -> None:
+        """Warm-restart half of the tier: the local daemon's registry
+        survived whatever killed the previous replica process — re-adopt
+        its entries as OUR adverts so the very next gossip beat makes
+        this replacement routable as prefix-warm. Failover stall then
+        ≈ fault-in pull latency, not a cold prefill. Filtered to OUR
+        model namespace: the registry is node-global, and re-adverting
+        another deployment's entries would route its KV to our model."""
+        try:
+            from ray_tpu.inference import kv_transfer
+
+            entries = kv_transfer.tier_list(ns=self._tier_ns)
+        except Exception:  # noqa: BLE001 — recovery is best-effort
+            return
+        cap = max(1, GLOBAL_CONFIG.kv_tier_max_adverts)
+        with self._tier_lock:
+            for digest_hex, desc in entries.items():
+                if len(self._tier_adverts) >= cap:
+                    break
+                self._tier_adverts[digest_hex] = desc
 
     def stop(self) -> None:
         self._stop.set()
         self._work.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self._tier_pub_thread is not None:
+            self._tier_pub_thread.join(timeout=10)
+            self._tier_pub_thread = None
         # the step loop is dead: queued/running requests can never emit
         # another token — fail them so callers blocked in tokens() wake
         # instead of hanging on q.get() forever
@@ -569,15 +695,27 @@ class InferenceEngine:
         return True
 
     # -- drain ------------------------------------------------------------
-    def begin_drain(self, grace_s: Optional[float] = None) -> None:
+    def begin_drain(
+        self, grace_s: Optional[float] = None, *, migrate: bool = False
+    ) -> None:
         """Stop admitting; in-flight (queued + running) requests keep
         decoding until done or the grace window closes, after which the
-        stragglers fail with :class:`RequestFailedError`."""
+        stragglers fail with :class:`RequestFailedError`.
+
+        ``migrate=True`` (tier deployments): instead of letting
+        in-flight decodes run the grace window out, the next step
+        flushes each one's FULL KV (prompt + generated — closing the
+        disagg gap where export covers prompt KV only) into the cluster
+        tier and fails it with :class:`KvMigrationHandoff`, which the
+        router treats as resumable — the stream continues on a survivor
+        via tier fault-in, client-invisible."""
         grace = GLOBAL_CONFIG.drain_grace_s if grace_s is None else grace_s
         with self._lock:
             self._draining = True
             self.scheduler.admitting = False
             self._drain_deadline = Deadline.after(grace)
+            if migrate and self.engine_cfg.kv_tier_enabled:
+                self._migrate_on_drain = True
         self._work.set()
 
     @property
@@ -627,7 +765,10 @@ class InferenceEngine:
             self._fail_all(
                 RequestFailedError("engine drain grace expired mid-generation")
             )
+        if self._migrate_on_drain:
+            self._migrate_inflight()
         did_import = self._drain_kv_imports()
+        self._drain_tier_spills()
         plan = self.scheduler.schedule()
         for req in plan.reaped:
             # every reap here is a deadline expiry (queued or running) —
@@ -671,6 +812,12 @@ class InferenceEngine:
                 # the prompt's K/V is fully written: index its full
                 # blocks so later requests sharing the prefix skip them
                 self.blocks.register_prefix(req.request_id, prompt)
+                if self.engine_cfg.kv_tier_enabled and not req.prefill_only:
+                    # tier write-back trigger 1: the prompt's full
+                    # blocks become cluster-recoverable the moment they
+                    # exist — a replica killed one token later already
+                    # left its prefill in the tier
+                    self._tier_writeback_full_blocks(req, prompt, "prefill")
                 if req.prefill_only:
                     # KV-migration export: gather the full blocks to
                     # host and hand the payload to the waiting exporter
@@ -925,6 +1072,205 @@ class InferenceEngine:
             except Exception as e:  # noqa: BLE001
                 reply.put((False, e))
 
+    # -- cluster KV tier (PR 17) ------------------------------------------
+    def _tier_spill(self, digest: bytes, blk: int, hits: int) -> bool:
+        """Spill half of the block manager's ONE spill-vs-drop policy
+        point — invoked under the manager lock at every indexed-block
+        eviction, so it only GATHERS here (one block, device→host) and
+        defers the heavy publish (shm write + daemon RPC) to
+        :meth:`_drain_tier_spills` on the next step. Popular blocks
+        (ever hit, or already tier-resident) spill; cold ones drop."""
+        digest_hex = digest.hex()
+        with self._tier_lock:
+            if digest_hex in self._tier_adverts or digest_hex in self._tier_pending:
+                return True  # already tier-resident/queued: content survives
+        if hits <= 0:
+            return False  # never reused since indexing: cold, drop
+        try:
+            kv = self.runner.gather_blocks([blk])
+        except Exception:  # noqa: BLE001 — a failed gather is a drop
+            return False
+        self._tier_spill_pending.append((digest, kv))
+        return True
+
+    def _drain_tier_spills(self) -> None:
+        if not self._tier_spill_pending:
+            return
+        pending, self._tier_spill_pending = self._tier_spill_pending, []
+        for digest, kv in pending:
+            self._tier_enqueue(digest, kv, "evict")
+
+    def _tier_enqueue(self, digest: bytes, kv, trigger: str) -> None:
+        """Hand one gathered block to the tier publisher thread. The
+        step thread only ever pays a lock + queue put here; the shm
+        write and daemon RPC happen off the token-emission path. A full
+        queue DROPS the write-back — tier warmth is best-effort and
+        must never backpressure decode."""
+        digest_hex = digest.hex()
+        with self._tier_lock:
+            if digest_hex in self._tier_adverts:
+                self._tier_adverts.move_to_end(digest_hex)
+                return
+            if digest_hex in self._tier_pending:
+                return
+            self._tier_pending.add(digest_hex)
+        try:
+            self._tier_pub_q.put_nowait((digest, kv, trigger))
+        except queue.Full:
+            with self._tier_lock:
+                self._tier_pending.discard(digest_hex)
+
+    def _tier_publish_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                digest, kv, trigger = self._tier_pub_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._tier_writeback(digest, kv, trigger)
+            except Exception:  # noqa: BLE001 — publish is best-effort
+                pass
+            finally:
+                with self._tier_lock:
+                    self._tier_pending.discard(digest.hex())
+                self._tier_pub_q.task_done()
+
+    def _drain_tier_pub_queue_sync(self) -> None:
+        """Publish everything still queued on the CALLER's thread —
+        the migrate path needs residency guaranteed before it errors
+        the streams, so it cannot leave work racing its own exit."""
+        while True:
+            try:
+                digest, kv, trigger = self._tier_pub_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                self._tier_writeback(digest, kv, trigger)
+            except Exception:  # noqa: BLE001
+                pass
+            finally:
+                with self._tier_lock:
+                    self._tier_pending.discard(digest.hex())
+                self._tier_pub_q.task_done()
+
+    def flush_tier_writebacks(self, timeout_s: float = 10.0) -> bool:
+        """Block until the deferred tier publisher is idle (queue empty
+        and no publish in flight). Tests and the migrate path use this
+        to turn the asynchronous write-back into a happens-before."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._tier_lock:
+                idle = not self._tier_pending
+            if idle and self._tier_pub_q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def _tier_writeback(self, digest: bytes, kv, trigger: str) -> None:
+        """Publish one block payload into the tier + advert it. Dedup
+        by advert: a digest this replica already adverts just refreshes
+        recency (idempotent republish would rewrite identical bytes).
+        Runs on the tier publisher thread (or the step thread for the
+        synchronous migrate flush) — advert mutations take _tier_lock,
+        the publish RPC deliberately does not."""
+        digest_hex = digest.hex()
+        with self._tier_lock:
+            if digest_hex in self._tier_adverts:
+                self._tier_adverts.move_to_end(digest_hex)
+                return
+        from ray_tpu.inference import kv_transfer
+        from ray_tpu.observability import rpc_metrics
+
+        desc = kv_transfer.tier_publish(
+            digest, kv, self.blocks.block_size, ns=self._tier_ns
+        )
+        if desc is None:
+            return
+        with self._tier_lock:
+            self._tier_adverts[digest_hex] = desc
+            self._tier_adverts.move_to_end(digest_hex)
+            # advert cap: dropping the LRU advert retracts it from the
+            # gossip (routers purge on the next report's advert-set diff) —
+            # the daemon registry may keep the bytes until ITS ttl/cap
+            cap = max(1, GLOBAL_CONFIG.kv_tier_max_adverts)
+            while len(self._tier_adverts) > cap:
+                self._tier_adverts.popitem(last=False)
+        rpc_metrics.KV_TIER_PUBLISHES.inc(labels={"trigger": trigger})
+
+    def _tier_writeback_full_blocks(
+        self, req: Request, written, trigger: str, sync: bool = False
+    ) -> None:
+        """Write back every full block of ``written`` (token positions
+        whose K/V is in the cache) that is not yet tier-resident. The
+        per-block chain digests are recomputed from tokens — the same
+        capability-name derivation any future reader uses. Gathers run
+        HERE (the step thread: device cache reads must not race the
+        loop's own swaps); the publish defers to the tier publisher
+        thread unless ``sync`` (migrate needs residency-before-error)."""
+        bs = self.blocks.block_size
+        owned = self.blocks.owned(req.request_id)
+        n_full = min(len(written) // bs, len(owned))
+        prev = b""
+        for i in range(n_full):
+            prev = _chain_digest(prev, written[i * bs : (i + 1) * bs])
+            with self._tier_lock:
+                resident = (
+                    prev.hex() in self._tier_adverts
+                    or prev.hex() in self._tier_pending
+                )
+            if resident:
+                continue
+            try:
+                kv = self.runner.gather_blocks([owned[i]])
+            except Exception:  # noqa: BLE001 — write-back is best-effort
+                return
+            if sync:
+                self._tier_writeback(prev, kv, trigger)
+            else:
+                self._tier_enqueue(prev, kv, trigger)
+
+    def _migrate_inflight(self) -> None:
+        """Drain-with-migration (consumer (a) of the tier): flush every
+        in-flight request's written KV — prompt AND generated — into
+        the tier, then fail it with :class:`KvMigrationHandoff` so
+        the router resumes it on a survivor that faults the KV back in.
+        The generated-token half is what plain disagg export never
+        covered; it is exactly the state a mid-stream failover used to
+        re-prefill via replay. Publishes run synchronously here: the
+        handoff error must not reach the router before the blocks are
+        tier-resident, or the survivor's fault-in races our exit."""
+        self._migrate_on_drain = False
+        self._drain_tier_pub_queue_sync()
+        self.flush_tier_writebacks(5.0)
+        for req in self.scheduler.take_all():
+            try:
+                # Only positions whose K/V truly reached the device
+                # cache: blocks are allocated for the WHOLE prompt at
+                # admission but chunked prefill writes incrementally —
+                # a mid-prefill request has written exactly
+                # effective_prompt[:prefill_pos] (a prefix of
+                # prompt+generated), and decode has written through
+                # context_len-1 once prefill is done. Publishing past
+                # that point would advert never-written device blocks
+                # under the VALID chain digest of the real tokens (the
+                # CRC gate covers transport, not content) and poison
+                # every future fault-in of that prefix.
+                end = (
+                    (req.context_len - 1) if req.prefill_done else req.prefill_pos
+                )
+                if end > 0:
+                    written = (req.prompt + req.generated)[:end]
+                    self._tier_writeback_full_blocks(
+                        req, written, "migrate", sync=True
+                    )
+            except Exception:  # noqa: BLE001 — flush failure → plain replay
+                pass
+            self.blocks.free(req.request_id)
+            req.state = FAILED
+            self._finish_request(
+                req, FAILED, error=KvMigrationHandoff(KV_MIGRATION_MARKER)
+            )
+
     def _emit_token(self, req: Request, token: int) -> None:
         if req.finished:
             # cancelled/failed after this step's plan was built but before
@@ -933,6 +1279,18 @@ class InferenceEngine:
             # FINISHED, double-counting requests_total
             return
         req.generated.append(token)
+        if self.engine_cfg.kv_tier_enabled:
+            # tier write-back trigger 2: each DECODE block boundary —
+            # position n_written-1's K/V was written by the step that
+            # sampled this token, so when n_written crosses a block
+            # boundary a new immutable full block exists. Flushing it
+            # now is what makes a mid-stream SIGKILL recoverable by
+            # fault-in: the generated prefix is already tier-resident.
+            n_written = len(req.prompt) + len(req.generated) - 1
+            if n_written > 0 and n_written % self.blocks.block_size == 0:
+                self._tier_writeback_full_blocks(
+                    req, (req.prompt + req.generated)[:n_written], "decode"
+                )
         now = time.monotonic()
         self._token_times.append(now)
         self.metrics["tokens_total"].inc()
@@ -1283,12 +1641,27 @@ class InferenceEngine:
         (replica -> controller push -> router). Everything here must
         stay small and picklable — it travels on every routing-set
         update."""
+        if self.engine_cfg.kv_tier_enabled:
+            # snapshot under the tier lock: the step + publisher threads
+            # move_to_end/popitem concurrently, and an unlocked dict()
+            # copy can raise "OrderedDict mutated during iteration" and
+            # fail the whole stats report
+            with self._tier_lock:
+                tier_adverts = dict(self._tier_adverts)
+        else:
+            tier_adverts = {}
         return {
             "queue_depth": self.scheduler.queue_depth(),
             "cache_util": round(self.blocks.utilization(), 4),
             "outstanding_tokens": self.scheduler.outstanding_tokens(),
             "block_size": self.blocks.block_size,
             "prefix_digest": self.blocks.prefix_digest(),
+            # tier adverts ride the same gossip beat: digest hex ->
+            # routable descriptor, bounded by kv_tier_max_adverts. A
+            # digest absent from a holder's NEXT report is thereby
+            # RETRACTED — routers diff per-actor advert sets and purge
+            # in one hop instead of waiting out a TTL.
+            "kv_tier": tier_adverts,
             "draining": self._draining,
             # queue-pressure export for the ingress tier: the admission
             # BOUND (so a proxy can judge fullness, not just depth) and

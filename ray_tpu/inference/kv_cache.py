@@ -120,6 +120,18 @@ class PagedBlockManager:
         self._lru: "OrderedDict[int, bytes]" = OrderedDict()
         #: request -> COW source blocks pinned until the device copy ran
         self._cow_src: Dict[str, List[int]] = {}
+        #: chain digest -> acquire_prefix hit count (the popularity
+        #: signal the spill-vs-drop policy reads at eviction)
+        self._hits: Dict[bytes, int] = {}
+        #: spill-vs-drop policy hook: ``fn(digest, block, hits) -> bool``
+        #: consulted at EVERY indexed-block eviction (allocation-pressure
+        #: LRU reclaim and the register cap-eviction — one policy point,
+        #: not two divergent code paths). True = the block's content was
+        #: spilled somewhere recoverable (the cluster KV tier), False =
+        #: dropped. Runs under the manager lock on the step thread: the
+        #: hook may read the device (the content dies with the return)
+        #: but MUST NOT re-enter locked manager methods or block on IO.
+        self._spill_hook = None
         self._lock = threading.Lock()
         # lifetime accounting (engine /metrics + stats())
         self.total_allocs = 0
@@ -130,6 +142,16 @@ class PagedBlockManager:
         self.prefix_tokens_saved_total = 0
         self.cow_copies_total = 0
         self.prefix_evictions_total = 0
+        #: books-balance split of prefix_evictions_total: every evicted
+        #: indexed block is EXACTLY one of spilled (content preserved in
+        #: the tier) or dropped (gone) — evictions == spilled + dropped
+        self.prefix_spilled_total = 0
+        self.prefix_dropped_total = 0
+
+    def set_spill_hook(self, hook) -> None:
+        """Install the spill-vs-drop policy (see ``_spill_hook``)."""
+        with self._lock:
+            self._spill_hook = hook
 
     # -- capacity ---------------------------------------------------------
     @property
@@ -164,16 +186,36 @@ class PagedBlockManager:
         with self._lock:
             return list(self._owned.get(request_id, ()))
 
+    def _evict_indexed_locked(self, blk: int, digest: bytes) -> None:
+        """Retire one indexed block from the radix structure — the ONE
+        spill-vs-drop policy decision point (ISSUE 17's unlocking
+        refactor: LRU eviction and the kv_transfer export path used to
+        be unrelated, so KV pressure silently destroyed reusable state).
+        The hook sees the block while its device content is still valid
+        and decides: spill (preserve in the tier) or drop."""
+        del self._index[digest]
+        del self._block_hash[blk]
+        self._parent.pop(digest, None)
+        hits = self._hits.pop(digest, 0)
+        self.prefix_evictions_total += 1
+        spilled = False
+        if self._spill_hook is not None:
+            try:
+                spilled = bool(self._spill_hook(digest, blk, hits))
+            except Exception:
+                spilled = False  # a broken policy degrades to drop
+        if spilled:
+            self.prefix_spilled_total += 1
+        else:
+            self.prefix_dropped_total += 1
+
     def _take_block_locked(self) -> Optional[int]:
         """One free block, reclaiming the LRU cached block if needed."""
         if self._free:
             return self._free.popleft()
         if self._lru:
             blk, digest = self._lru.popitem(last=False)
-            del self._index[digest]
-            del self._block_hash[blk]
-            self._parent.pop(digest, None)
-            self.prefix_evictions_total += 1
+            self._evict_indexed_locked(blk, digest)
             return blk
         return None
 
@@ -281,6 +323,9 @@ class PagedBlockManager:
                 # refresh use-recency so hot prefixes stay in the
                 # truncated gossip digest window
                 self._index.move_to_end(prev)
+                # popularity signal for the eviction-time spill-vs-drop
+                # decision ("spill popular, drop cold")
+                self._hits[prev] = self._hits.get(prev, 0) + 1
                 hits.append(blk)
             if not hits:
                 return 0, []
@@ -373,11 +418,8 @@ class PagedBlockManager:
                     if not self._lru:
                         break  # cap reached, nothing evictable
                     old_blk, old_digest = self._lru.popitem(last=False)
-                    del self._index[old_digest]
-                    del self._block_hash[old_blk]
-                    self._parent.pop(old_digest, None)
+                    self._evict_indexed_locked(old_blk, old_digest)
                     self._free.append(old_blk)
-                    self.prefix_evictions_total += 1
                 self._block_hash[blk] = prev
                 self._index[prev] = blk
                 self._parent[prev] = parent
@@ -515,6 +557,9 @@ class PagedBlockManager:
             "tokens_saved_total": self.prefix_tokens_saved_total,
             "cow_copies_total": self.cow_copies_total,
             "evictions_total": self.prefix_evictions_total,
+            # spill-vs-drop books: evictions == spilled + dropped, always
+            "spilled_total": self.prefix_spilled_total,
+            "dropped_total": self.prefix_dropped_total,
         }
 
     # -- introspection ----------------------------------------------------

@@ -152,6 +152,23 @@ class LLMServer:
             # ledger stage: the KV fetch+scatter ran BEFORE submit, so
             # its cost is handed to the engine's ledger as a pre-stage
             ledger_stages["kv_import"] = _time.monotonic() - t0
+        tier = r.pop("kv_tier", None)
+        if tier is not None:
+            # cluster KV-tier fault-in (PR 17): unlike kv_import this
+            # runs on EVERY attempt — on a resume the prompt already
+            # carries the delivered tokens, and the router re-attached
+            # descriptors for the extended token chain, so a failover's
+            # "replay" becomes tier hits instead of re-prefill. Every
+            # failure rung degrades toward plain prefix replay; the
+            # stream itself can never fail here.
+            t0 = _time.monotonic()
+            committed = self._import_tier(tier, r["prompt"])
+            ledger_stages["kv_tier"] = _time.monotonic() - t0
+            # the router books replayed=0 when the chain COVERED the
+            # stream — but the fallback outcome is only known HERE, so
+            # a covered-but-failed fault-in reconciles its real replay
+            # cost into the resume counters from the replica side
+            self._reconcile_tier_replay(tier, r["prompt"], resume_from, committed)
         if resume_from is None:
             yield from self.engine.generate(
                 r["prompt"],
@@ -279,6 +296,160 @@ class LLMServer:
             kv_transfer.count_fallback("import")
             return False
 
+    def _import_tier(self, spec: Dict[str, Any], prompt) -> int:
+        """Cluster KV-tier consumer: fault the router-attached prefix
+        blocks in (zero-copy pull, digest-before-attach, keep_source —
+        tier reads never consume the entry) and commit them into this
+        engine's cache. ``spec`` is ``{"blocks": [[digest_hex, desc],
+        ...]}`` — a consecutive root-anchored chain the router matched
+        against the request's tokens, so a fetched block's KV provably
+        belongs to exactly that token prefix (chain-digest keying).
+
+        Counted fallback ladder, longest-valid-prefix semantics: the
+        first block that fails STOPS the chain (later blocks would be
+        unreachable in the radix index anyway) and everything already
+        fetched still commits — partial warmth beats none. Returns the
+        number of tokens committed; 0 means the caller proceeds on pure
+        prefix replay / cold prefill, byte-exact either way."""
+        import os
+        import signal
+
+        from ray_tpu.core.config import GLOBAL_CONFIG
+        from ray_tpu.inference import kv_transfer
+        from ray_tpu.observability import rpc_metrics
+
+        eng = self.engine
+        blocks = list(spec.get("blocks") or ())
+        if not blocks:
+            return 0
+        bs = eng.blocks.block_size
+        cache_k = eng.runner.cache["k"]  # [L, N, bs, n_kv, hd]
+        expect = (
+            2, cache_k.shape[0], 1, cache_k.shape[2],
+            cache_k.shape[3], cache_k.shape[4],
+        )
+        fetched: List[Any] = []
+        try:
+            for _digest_hex, desc in blocks:
+                if str(desc.get("tier_ns") or "") != getattr(eng, "_tier_ns", ""):
+                    # model-identity gate: the chain digest names the
+                    # TOKENS, not the weights that computed the KV — a
+                    # descriptor published under another deployment's
+                    # namespace passes every shape/dtype check (same
+                    # architecture!) yet holds a different model's KV
+                    rpc_metrics.KV_TIER_FALLBACKS.inc(
+                        labels={"reason": "namespace"}
+                    )
+                    break
+                shape = tuple(desc.get("shape") or ())
+                if (
+                    len(shape) != 6
+                    or int(desc.get("block_size") or 0) != bs
+                    or any(s != e for s, e in zip(shape, expect))
+                    or str(desc.get("dtype")) != str(cache_k.dtype)
+                ):
+                    rpc_metrics.KV_TIER_FALLBACKS.inc(
+                        labels={"reason": "shape"}
+                    )
+                    break
+                try:
+                    payload = kv_transfer.tier_fetch(
+                        desc,
+                        timeout_s=(
+                            GLOBAL_CONFIG.serve_disagg_handoff_timeout_s
+                        ),
+                    )
+                except kv_transfer.KvTransferError as e:
+                    msg = str(e)
+                    reason = (
+                        "missing" if "missing" in msg
+                        else "digest" if "digest" in msg
+                        else "transfer"
+                    )
+                    rpc_metrics.KV_TIER_FALLBACKS.inc(
+                        labels={"reason": reason}
+                    )
+                    break
+                fetched.append(payload)
+            if not fetched:
+                return 0
+            verdict = kv_transfer.consult_tier_chaos("migration")
+            if verdict is not None and verdict[0] == "kill_mid_migration":
+                # die exactly like a replica lost mid-migration: blocks
+                # fetched, nothing committed, process gone without a
+                # goodbye. The router's resume machinery is the fallback
+                # rung (STREAM_RESUMES counts it); the tier entries
+                # survive in their holder daemons for the next attempt.
+                os.kill(os.getpid(), signal.SIGKILL)
+            import numpy as _np
+
+            kv = (
+                fetched[0].array
+                if len(fetched) == 1
+                else _np.concatenate([f.array for f in fetched], axis=2)
+            )
+            covered = len(fetched) * bs
+            n = eng.import_kv_blocks(
+                [int(t) for t in prompt[:covered]], kv
+            )
+            if n > 0:
+                rpc_metrics.KV_TIER_HITS.inc(n // bs)
+                rpc_metrics.KV_TIER_BYTES.inc(
+                    sum(int(f.array.nbytes) for f in fetched),
+                    labels={"direction": "fault_in"},
+                )
+            return int(n)
+        except Exception:  # noqa: BLE001 — fault-in must never fail a stream
+            rpc_metrics.KV_TIER_FALLBACKS.inc(labels={"reason": "import"})
+            return 0
+        finally:
+            for f in fetched:
+                f.close()
+
+    def _reconcile_tier_replay(
+        self, spec: Optional[Dict[str, Any]], prompt, resume_from, committed: int
+    ) -> None:
+        """Replay accounting for RESUME attempts: the router books
+        ``replayed=0`` when the attached chain COVERS the stream,
+        trusting the fault-in — but only this side knows whether it
+        actually landed. When a covered chain commits short (fallback
+        ladder: missing holder, digest mismatch, import failure), the
+        positions the router assumed warm get re-prefilled here, and the
+        delivered-region share of that work is real replay — book the
+        shortfall into the same sinks the router uses so covered-but-
+        failed fault-ins stop undercounting replay."""
+        try:
+            seq = int(resume_from or 0)
+            if seq <= 0 or not spec:
+                return
+            n_blocks = len(spec.get("blocks") or ())
+            tokens = int(spec.get("tokens") or 0)
+            if n_blocks <= 0 or tokens <= 0:
+                return
+            bs = tokens // n_blocks
+            prompt_len = len(prompt)
+            if tokens < prompt_len - bs:
+                return  # not covered: the router counted the replay
+            # positions assumed warm but re-prefilled, clipped to the
+            # delivered region (re-prefilling the ORIGINAL prompt is
+            # prompt work any attempt-0 request pays too, not replay)
+            owed = max(0, tokens - max(int(committed), prompt_len - seq))
+            if owed <= 0:
+                return
+            from ray_tpu.observability import rpc_metrics
+            from ray_tpu.observability.slo import slo_metrics
+
+            rpc_metrics.STREAM_RESUME_REPLAY_TOKENS.inc(owed)
+            slo_metrics()["fault"].inc(
+                owed,
+                labels={
+                    "deployment": self.engine.slo_deployment,
+                    "reason": "resume_replay",
+                },
+            )
+        except Exception:  # noqa: BLE001 — accounting never fails a stream
+            pass
+
     def cancel(self, request_id: str) -> bool:
         """Cancel a queued/running request by id; frees its KV blocks.
         The serve stream-close path usually beats callers to it (an
@@ -315,9 +486,16 @@ class LLMServer:
             return None
         return f"{self._metrics_server.host}:{self._metrics_server.port}"
 
-    def begin_drain(self, grace_s: Optional[float] = None) -> None:
-        """Test/ops hook: drain without a node event."""
-        self.engine.begin_drain(grace_s)
+    def begin_drain(
+        self, grace_s: Optional[float] = None, migrate: bool = False
+    ) -> None:
+        """Test/ops hook: drain without a node event. ``migrate=True``
+        (tier-enabled engines only) additionally hands every in-flight
+        decode's FULL KV — prompt plus generated — to the tier and fails
+        the requests with the resumable migration marker, so the router
+        moves each stream to a survivor that admits it as tier hits:
+        live decode migration instead of drain-then-replay."""
+        self.engine.begin_drain(grace_s, migrate=migrate)
 
     def check_health(self) -> bool:
         """Polled by the serve controller (replica.health): False once
@@ -332,6 +510,17 @@ class LLMServer:
         from ray_tpu.util.chaos import ReplicaFaultPlan
 
         self.engine.testing_fault_plan = ReplicaFaultPlan(spec, seed)
+        return seed
+
+    def testing_arm_kv_tier_chaos(self, spec: str, seed: int) -> int:
+        """Test hook: install a KvTierFaultPlan in THIS replica's
+        kv_transfer module only (surgical tier chaos — the env plan
+        would arm every process including controller replacements).
+        Returns the seed for the repro line."""
+        from ray_tpu.inference import kv_transfer
+        from ray_tpu.util.chaos import KvTierFaultPlan
+
+        kv_transfer.testing_tier_plan = KvTierFaultPlan(spec, seed)
         return seed
 
     def __del__(self):
@@ -355,6 +544,7 @@ def llm_deployment(
     seed: int = 0,
     autoscaling_config=None,
     version: Optional[str] = None,
+    kv_tier: bool = False,
     disaggregated: bool = False,
     prefill_replicas: int = 1,
     decode_replicas: Optional[int] = None,
@@ -390,8 +580,27 @@ def llm_deployment(
     never recompile. Handoff failures at every rung degrade to plain
     single-replica generation — ``disaggregated`` changes the cost
     profile, never the token stream (deterministic continuation makes
-    the handoff byte-exact by construction)."""
+    the handoff byte-exact by construction).
+
+    ``kv_tier=True`` opts every replica into the cluster-wide KV prefix
+    tier (README "KV prefix tier"): engines write popular full prefix
+    blocks back into daemon-owned tier storage and advertise them
+    through the routing gossip, replicas fault advertised prefixes in
+    over the zero-copy pull path, and mid-stream failovers resume as
+    tier hits instead of replayed prefill. Forces
+    ``kv_transfer_enabled`` too (the tier rides the same data plane).
+    Off by default: tier write-back warms gather/scatter programs and
+    changes the warmup compile set."""
     from ray_tpu import serve
+
+    if kv_tier:
+        import dataclasses as _dc
+
+        from ray_tpu.inference.engine import EngineConfig as _EC
+
+        engine = _dc.replace(
+            engine or _EC(), kv_transfer_enabled=True, kv_tier_enabled=True
+        )
 
     if not disaggregated:
         dep = serve.deployment(

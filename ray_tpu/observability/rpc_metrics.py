@@ -243,3 +243,53 @@ INGRESS_TTFB = Histogram(
     "raytpu_ingress_ttfb_seconds",
     "ingress request arrival to first response byte",
 )
+
+# -- cluster-wide KV prefix tier (inference/kv_transfer.py tier layer) ------
+# The warm-recovery plane: blocks written back into daemon-owned tier
+# storage (spill or explicit write-back), faulted in by replicas on
+# resume/restart, and the fallback-ladder rungs taken when the tier
+# could not serve (chaos, reaped entries, digest rejections).
+
+#: full prefix blocks written back into the tier, by trigger — prefill
+#: (prompt blocks at prefill completion), decode (newly-completed
+#: generation blocks mid-stream), evict (the spill half of the
+#: spill-vs-drop eviction policy), migrate (drain-time handoff flush)
+KV_TIER_PUBLISHES = Counter(
+    "raytpu_kv_tier_publishes_total",
+    "KV prefix blocks written back into the cluster tier, by trigger",
+    ("trigger",),
+)
+
+#: tier blocks successfully faulted in and committed into a replica's
+#: paged cache (each one is a block of prefill the cluster skipped)
+KV_TIER_HITS = Counter(
+    "raytpu_kv_tier_hits_total",
+    "KV prefix blocks faulted in from the tier and committed",
+)
+
+#: tier fault-in attempts that fell down the ladder, by reason —
+#: missing (entry gone / no source), digest (integrity gate refused the
+#: payload), transfer (pull failed), import (scatter/commit failed),
+#: chaos_kill (migration killed mid-scatter). Every inc is one rung
+#: down toward PR 10 prefix replay, which stays byte-exact regardless.
+KV_TIER_FALLBACKS = Counter(
+    "raytpu_kv_tier_fallbacks_total",
+    "tier fault-in attempts degraded to the next fallback rung, by reason",
+    ("reason",),
+)
+
+#: tier adverts EXPLICITLY retracted from the routing gossip by a live
+#: holder (eviction/drop), counted router-side — death-TTL expiries are
+#: not retractions (the daemon may still hold the bytes)
+KV_TIER_RETRACTIONS = Counter(
+    "raytpu_kv_tier_retractions_total",
+    "tier prefix adverts retracted from router directories by holders",
+)
+
+#: tier bytes moved, by direction (publish = write-back into the tier,
+#: fault_in = pulled into a replica's cache)
+KV_TIER_BYTES = Counter(
+    "raytpu_kv_tier_bytes_total",
+    "KV bytes moved through the cluster tier, by direction",
+    ("direction",),
+)

@@ -169,6 +169,17 @@ class Router:
         # since that replica's last gossip (cleared by fresher gossip) so
         # a burst inside one gossip period spreads instead of dogpiling
         self._local_tokens: Dict[Any, float] = {}
+        # cluster KV-tier directory (PR 17): chain-digest hex ->
+        # (descriptor, holder actor_id, refreshed_at). Fed by the
+        # replicas' "kv_tier" routing-gossip adverts; a LIVE holder
+        # dropping a digest from its advert set RETRACTS the entry in
+        # one gossip hop, while a DEAD holder's entries linger for
+        # kv_tier_advert_ttl_s — the daemon, not the replica process,
+        # owns the bytes, and a warm replacement re-adverts them
+        self._tier_dir: Dict[str, tuple] = {}
+        # replica actor_id -> frozenset of advertised digest hexes (the
+        # previous report's view, diffed per report for retraction)
+        self._tier_adverts: Dict[Any, frozenset] = {}
         self._poller_started = False
         self._poller_lock = threading.Lock()
         #: deployment meta (resumable_streams declaration + paired
@@ -239,6 +250,38 @@ class Router:
             self._local_tokens = {
                 k: v for k, v in self._local_tokens.items() if k in live
             }
+            retractions = 0
+            for aid, ent in rstats.items():
+                adverts = ent[1].get("kv_tier") or {}
+                advert_set = frozenset(adverts)
+                prev_set = self._tier_adverts.get(aid)
+                if prev_set:
+                    # a digest a LIVE holder stopped advertising was
+                    # evicted from its daemon's tier: purge in one hop
+                    # instead of letting fault-ins chase it to a miss
+                    for gone in prev_set - advert_set:
+                        cur = self._tier_dir.get(gone)
+                        if cur is not None and cur[1] == aid:
+                            del self._tier_dir[gone]
+                            retractions += 1
+                for dh, desc in adverts.items():
+                    self._tier_dir[dh] = (desc, aid, now)
+                self._tier_adverts[aid] = advert_set
+            self._tier_adverts = {
+                k: v for k, v in self._tier_adverts.items() if k in live
+            }
+            if self._tier_dir:
+                # dead-holder retention: keep the entry (the daemon may
+                # still serve it to a warm replacement) but not forever
+                ttl = GLOBAL_CONFIG.kv_tier_advert_ttl_s
+                self._tier_dir = {
+                    dh: ent for dh, ent in self._tier_dir.items()
+                    if ent[1] in live or now - ent[2] < ttl
+                }
+        if retractions:
+            from ray_tpu.observability.rpc_metrics import KV_TIER_RETRACTIONS
+
+            KV_TIER_RETRACTIONS.inc(retractions)
         if replicas:
             self._have_replicas.set()
         else:
@@ -256,6 +299,11 @@ class Router:
             self._models.pop(replica.actor_id, None)
             self._rstats.pop(replica.actor_id, None)
             self._local_tokens.pop(replica.actor_id, None)
+            # death is NOT retraction: the holder's daemon still has the
+            # tier bytes, so _tier_dir entries stay (TTL-bounded) for the
+            # resume that is about to need them — only the per-actor
+            # advert view goes, there will be no more reports to diff
+            self._tier_adverts.pop(replica.actor_id, None)
             if not self._replicas:
                 self._have_replicas.clear()
 
@@ -593,6 +641,69 @@ class Router:
     def _resumable_methods(self) -> frozenset:
         return frozenset(self._deployment_meta().get("resumable_streams") or ())
 
+    # -- cluster KV tier (PR 17) -------------------------------------------
+    def _tier_attach(self, prompt: List[int]) -> Optional[Dict[str, Any]]:
+        """Longest consecutive root-anchored chain of tier-advertised
+        prefix blocks covering ``prompt``, as the ``kv_tier`` request
+        spec (``{"blocks": [[digest_hex, desc], ...], "tokens": n}``) —
+        or None when the directory covers nothing. The chain digest is
+        recomputed HERE from the request's own tokens, so a matched
+        descriptor provably holds KV for exactly this prefix (same
+        capability-name scheme the replica re-verifies on commit).
+        Chains stop one token short of the full prompt: admission needs
+        a tail to prefill, exactly like the disagg import."""
+        with self._replicas_lock:
+            if not self._tier_dir:
+                return None
+            tier_dir = dict(self._tier_dir)
+        from ray_tpu.inference.kv_cache import _chain_digest
+
+        bs = 0
+        for ent in tier_dir.values():
+            bs = int(ent[0].get("block_size") or 0)
+            if bs > 0:
+                break
+        if bs <= 0 or len(prompt) <= bs:
+            return None
+        blocks: List[Any] = []
+        prev = b""
+        for i in range((len(prompt) - 1) // bs):
+            d = _chain_digest(
+                prev, tuple(int(t) for t in prompt[i * bs : (i + 1) * bs])
+            )
+            ent = tier_dir.get(d.hex())
+            if ent is None:
+                break
+            blocks.append([d.hex(), ent[0]])
+            prev = d
+        if not blocks:
+            return None
+        return {"blocks": blocks, "tokens": len(blocks) * bs}
+
+    def _tier_resume_spec(
+        self, prompt: List[int], wait_s: float = 0.0
+    ) -> tuple:
+        """Tier chain for a RESUME attempt: ``(spec_or_None, covered)``
+        where ``covered`` means the chain reaches everything but the
+        sub-block tail — the resume is then a fault-in, not a replay,
+        and the replay counters must not grow. ``wait_s`` bounds a brief
+        poll for adverts still in flight through the gossip (the live-
+        migration window: the source flushed its KV a beat ago and the
+        stats report carrying the adverts may not have landed yet)."""
+        deadline = time.monotonic() + wait_s
+
+        def _covers(spec) -> bool:
+            if spec is None:
+                return False
+            bs = int(spec["tokens"]) // max(1, len(spec["blocks"]))
+            return int(spec["tokens"]) >= len(prompt) - bs
+
+        spec = self._tier_attach(prompt)
+        while not _covers(spec) and time.monotonic() < deadline:
+            time.sleep(0.05)
+            spec = self._tier_attach(prompt)
+        return spec, _covers(spec)
+
     # -- disaggregated prefill/decode handoff ------------------------------
     def _disagg_handoff(
         self,
@@ -781,6 +892,15 @@ class Router:
         if prefill_dep and "kv_import" not in req:
             self._disagg_handoff(prefill_dep, req, model_id, budget)
         base_prompt = [int(t) for t in req["prompt"]]
+        if "kv_import" not in req and "kv_tier" not in req:
+            # cluster-tier warm admission: a fresh dispatch whose prefix
+            # chain is tier-resident anywhere imports it instead of
+            # prefilling — this is what makes a controller-spawned
+            # replacement WARM from its first request (the dead
+            # replica's adverts outlive it in the directory)
+            spec = self._tier_attach(base_prompt)
+            if spec is not None:
+                req["kv_tier"] = spec
         base_rid = str(req["request_id"])
         gate = SeqGate(0)
         delivered: List[int] = []
@@ -863,6 +983,11 @@ class Router:
             failover_since: Optional[float] = None
             attempt = 0
             barren = 0
+            #: tier chain computed at the LAST failover for the extended
+            #: prompt (base + delivered) — attached to the next attempt
+            #: so the survivor faults the stream's KV in instead of
+            #: replaying it through prefill
+            pending_tier: Optional[Dict[str, Any]] = None
             last_err: Optional[Exception] = None
             try:
                 while True:
@@ -886,9 +1011,14 @@ class Router:
                         attempt_req["resume_attempt"] = attempt
                         # the KV descriptor belongs to attempt 0's dispatch:
                         # a resume survivor warm-replays through its own
-                        # radix cache (PR 10); re-importing would add a
-                        # transfer to the failover path for nothing
+                        # radix cache (PR 10) — or, preferably, faults the
+                        # whole chain in from the cluster tier (PR 17):
+                        # the pending_tier spec computed at failover time
+                        # replaces the single-consumer kv_import
                         attempt_req.pop("kv_import", None)
+                        attempt_req.pop("kv_tier", None)
+                        if pending_tier is not None:
+                            attempt_req["kv_tier"] = pending_tier
                     # per-attempt budget: a resume is a fresh dispatch +
                     # time-to-next-token window, not a continuation of the
                     # first attempt's (possibly spent) dispatch budget
@@ -1002,10 +1132,60 @@ class Router:
                                 raise
                         attempt += 1
                         led["resumes"] += 1
-                        led["replayed_tokens"] += len(delivered)
+                        # tier-first failover: when the directory holds
+                        # the stream's whole chain (dead-holder entries
+                        # included — the daemon outlives the replica),
+                        # the survivor faults it in and the delivered
+                        # tokens are NOT replay work — both replay sinks
+                        # (counter and ledger) get the same gated value.
+                        # A covered chain whose fault-in then FAILS on
+                        # the survivor is reconciled replica-side
+                        # (LLMServer._reconcile_tier_replay books the
+                        # shortfall), so replayed=0 here is not final.
+                        pending_tier, covered = self._tier_resume_spec(
+                            base_prompt + delivered
+                        )
+                        replayed = 0 if covered else len(delivered)
+                        led["replayed_tokens"] += replayed
                         if failover_since is None:
                             failover_since = time.monotonic()
-                        _count_stream_resume(self._deployment, len(delivered))
+                        _count_stream_resume(self._deployment, replayed)
+                        continue
+                    except Exception as e:
+                        from ray_tpu.inference.kv_transfer import (
+                            KV_MIGRATION_MARKER,
+                        )
+
+                        if KV_MIGRATION_MARKER not in str(e):
+                            raise
+                        # live decode migration: a draining replica
+                        # flushed this stream's FULL KV (prompt +
+                        # generated) into the tier and failed the
+                        # request with the resumable marker. Same
+                        # failover machinery as a death — but the
+                        # replica is alive (don't drop it; its gossip
+                        # says draining, so scoring routes around it)
+                        # and the adverts may still be in flight, so
+                        # the spec poll waits a few gossip beats.
+                        last_err = e
+                        if gate.next_seq == progress_before:
+                            barren += 1
+                            if barren >= _MAX_BARREN_RESUMES:
+                                raise
+                        attempt += 1
+                        led["resumes"] += 1
+                        pending_tier, covered = self._tier_resume_spec(
+                            base_prompt + delivered,
+                            wait_s=max(
+                                1.0,
+                                3 * GLOBAL_CONFIG.serve_replica_stats_period_s,
+                            ),
+                        )
+                        replayed = 0 if covered else len(delivered)
+                        led["replayed_tokens"] += replayed
+                        if failover_since is None:
+                            failover_since = time.monotonic()
+                        _count_stream_resume(self._deployment, replayed)
                         continue
                     finally:
                         # every exit — normal end, failover to the next

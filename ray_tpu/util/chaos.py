@@ -350,6 +350,112 @@ class ReplicaFaultPlan:
         return None
 
 
+#: KV-tier chaos fault modes (consulted by the tier fault-in path in
+#: ``inference/kv_transfer.py`` / ``inference/serve_llm.py`` once per
+#: executed tier phase).
+#: missing_block — a tier block a router's directory promised is gone
+#:   when the replica tries to fault it in (reaped between advert and
+#:   fetch): the fetch is skipped, the fallback ladder engages.
+#: corrupt_block — the faulted-in payload is bit-flipped before the
+#:   digest-before-attach gate, which must REJECT it (the tier never
+#:   silently serves wrong KV; fallback ladder engages).
+#: stale_advert — the holder's tier entry is deleted right before the
+#:   fetch, modeling an advert the retraction hasn't reached the router
+#:   yet: the pull fails fast with no source and falls through in one
+#:   hop, not a timeout.
+#: kill_mid_migration — SIGKILL the importing replica while it is
+#:   scattering faulted-in tier KV: the resumable-stream machinery must
+#:   carry the request to yet another survivor.
+KV_TIER_FAULT_MODES = (
+    "missing_block",
+    "corrupt_block",
+    "stale_advert",
+    "kill_mid_migration",
+)
+
+
+class KvTierFaultPlan:
+    """Seeded KV-tier fault plan (``RAY_TPU_testing_kv_tier_chaos``).
+
+    Spec grammar (same shape as :class:`ReplicaFaultPlan`)::
+
+        "<mode>:<prob>[:<param>][:<max>][, ...]"
+
+    ``param`` is the number of matching-phase consults to SKIP before
+    the rule becomes eligible (default 0); ``max`` is the per-process
+    injection cap (default 1 — env-installed plans re-arm in every
+    replacement replica, so an uncapped rule would starve the fallback
+    ladder's terminal rung forever).
+
+    Consults happen once per tier phase that executes: ``"fault_in"``
+    when a replica starts pulling an advertised block and
+    ``"migration"`` when faulted-in KV is being scattered into the
+    cache. Modes match phases: the three block faults match
+    ``fault_in``; ``kill_mid_migration`` matches ``migration``.
+
+    DETERMINISM CONTRACT (same as :class:`RpcFaultPlan`): exactly one
+    RNG draw per consult, whether or not any rule matches — the full
+    injection schedule is a pure function of (seed, ordered consulted
+    phases), so a failure log carrying seed + spec replays exactly.
+    """
+
+    def __init__(self, spec: str, seed: int):
+        self.spec = spec
+        self.seed = seed
+        #: [mode, prob, param, max_injections]
+        self.rules: List[List[float]] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) < 2:
+                raise ValueError(
+                    f"bad kv-tier chaos rule {part!r} (need mode:prob)"
+                )
+            mode, prob = fields[0], float(fields[1])
+            if mode not in KV_TIER_FAULT_MODES:
+                raise ValueError(
+                    f"unknown kv-tier chaos mode {mode!r} "
+                    f"(one of {KV_TIER_FAULT_MODES})"
+                )
+            param = float(fields[2]) if len(fields) > 2 else 0.0
+            cap = int(fields[3]) if len(fields) > 3 else 1
+            self.rules.append([mode, prob, param, cap])
+        self._rng = random.Random(seed)
+        self.consults = 0
+        self.injections = 0
+        self._phase_consults = [0] * len(self.rules)
+        self._injected = [0] * len(self.rules)
+
+    @staticmethod
+    def _matches(mode: str, phase: str) -> bool:
+        if mode == "kill_mid_migration":
+            return phase == "migration"
+        return phase == "fault_in"
+
+    def consult(self, phase: str) -> Optional[Tuple[str, float]]:
+        """One deterministic consult for a tier phase (``"fault_in"`` |
+        ``"migration"``): ``(mode, param)`` to inject, else None.
+        Exactly one RNG draw regardless of outcome."""
+        draw = self._rng.random()  # ALWAYS one draw (see class docstring)
+        self.consults += 1
+        for i, (mode, prob, param, cap) in enumerate(self.rules):
+            if not self._matches(mode, phase):
+                continue
+            self._phase_consults[i] += 1
+            if self._phase_consults[i] <= param:
+                return None  # inside the skip window
+            if self._injected[i] >= cap:
+                return None
+            if draw < prob:
+                self._injected[i] += 1
+                self.injections += 1
+                return (mode, param)
+            return None  # first matching rule owns the draw
+        return None
+
+
 def find_worker_pids(controller_addr: str) -> List[int]:
     """PIDs of worker_main processes attached to ``controller_addr``
     (shared /proc scan: ``util/reaper.py::find_runtime_pids``)."""

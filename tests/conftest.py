@@ -152,6 +152,7 @@ def _activated_plans():
         ("ray_tpu.core.rpc", "testing_rpc_chaos"),
         ("ray_tpu.core.pull_manager", "testing_pull_chaos"),
         ("ray_tpu.inference.engine", "testing_replica_chaos"),
+        ("ray_tpu.inference.kv_transfer", "testing_kv_tier_chaos"),
     )
     import importlib
     import sys as _sys
@@ -177,6 +178,7 @@ def _chaos_repro_line(nodeid: str):
         ("testing_rpc_chaos", "testing_rpc_chaos_seed"),
         ("testing_pull_chaos", "testing_pull_chaos_seed"),
         ("testing_replica_chaos", "testing_replica_chaos_seed"),
+        ("testing_kv_tier_chaos", "testing_kv_tier_chaos_seed"),
     ):
         spec = getattr(cfg, spec_key)
         if spec and spec_key not in entries:
@@ -204,6 +206,7 @@ def _chaos_repro_line(nodeid: str):
         "testing_rpc_chaos": "rpc",
         "testing_pull_chaos": "pull",
         "testing_replica_chaos": "replica",
+        "testing_kv_tier_chaos": "kv_tier",
     }
     try:
         master = int(

@@ -6,6 +6,15 @@ exits cleanly; actor restarts it causes consume no ``max_restarts``
 budget; Train takes an urgent checkpoint on the warning; Serve hands
 traffic off with zero client-visible errors. ``PreemptionKiller``
 delivers the real contract: SIGTERM warning, SIGKILL after the grace.
+
+Suite-time relief (ROADMAP CAUTION): ONE module-scoped cluster; every
+test adds its own sacrificial node under a test-UNIQUE resource name and
+drains/kills only that node, so leftover replacement capacity from an
+earlier test can never host a later test's pinned work. The module
+cluster runs with ``drain_grace_s=3.0`` (set BEFORE the head spawns so
+every daemon inherits it): a plain actor never exits on its own, so
+actor-hosting drains wait the full grace — 3s keeps that fast without
+changing the semantics under test.
 """
 
 import os
@@ -15,6 +24,7 @@ import time
 import pytest
 
 import ray_tpu
+from conftest import wait_for_node_resource
 from ray_tpu.cluster_utils import Cluster
 from ray_tpu.util.chaos import PreemptionKiller
 
@@ -30,6 +40,32 @@ def _wait(pred, timeout=60, msg=""):
 
 def _node_rows():
     return {n["NodeID"]: n for n in ray_tpu.nodes()}
+
+
+@pytest.fixture(scope="module")
+def drain_cluster():
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    old_grace = GLOBAL_CONFIG.drain_grace_s
+    old_health = GLOBAL_CONFIG.health_check_period_s
+    old_thresh = GLOBAL_CONFIG.health_check_failure_threshold
+    GLOBAL_CONFIG.drain_grace_s = 3.0
+    # SIGKILLed nodes (grace-expiry, preemption tests) are detected via
+    # the health loop: staleness window (period×threshold) + threshold
+    # failed pings. 0.5s×3 cuts detection from ~5-6s to ~3s per kill
+    # without changing the two-stage semantics under test.
+    GLOBAL_CONFIG.health_check_period_s = 0.5
+    GLOBAL_CONFIG.health_check_failure_threshold = 3
+    cluster = Cluster(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    try:
+        yield cluster
+    finally:
+        GLOBAL_CONFIG.drain_grace_s = old_grace
+        GLOBAL_CONFIG.health_check_period_s = old_health
+        GLOBAL_CONFIG.health_check_failure_threshold = old_thresh
+        ray_tpu.shutdown()
+        cluster.shutdown()
 
 
 def test_maintenance_event_probe_is_pluggable():
@@ -55,196 +91,172 @@ def test_maintenance_event_probe_is_pluggable():
         tpu_mod.set_metadata_fetcher(None)
 
 
-def test_drain_excludes_node_from_scheduling():
+def test_drain_excludes_node_from_scheduling(drain_cluster):
     """A DRAINING node stops receiving new tasks; it deregisters and its
     daemon exits 0 once idle (clean-exit half of the drain contract)."""
-    cluster = Cluster(num_cpus=1)
-    n2 = cluster.add_node(num_cpus=4, resources={"pin": 4})
-    time.sleep(1.0)
-    ray_tpu.init(address=cluster.address)
-    try:
+    n2 = drain_cluster.add_node(num_cpus=4, resources={"excl": 4})
+    wait_for_node_resource("excl")
 
-        @ray_tpu.remote(num_cpus=0.5)
-        def where():
+    @ray_tpu.remote(num_cpus=0.5)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # warm up: reach the pinned node at least once
+    nid2 = None
+    for _ in range(4):
+        nid = ray_tpu.get(where.options(resources={"excl": 1}).remote(), timeout=60)
+        nid2 = nid
+    assert nid2 is not None
+    assert ray_tpu.drain_node(nid2, "test: scheduling exclusion")
+    # the daemon drains (idle) and deregisters: entry goes DEAD, no
+    # ghost DRAINING row, process exits 0
+    _wait(
+        lambda: _node_rows()[nid2]["State"] == "DEAD",
+        timeout=30,
+        msg="drained node should deregister to DEAD",
+    )
+    _wait(lambda: n2.poll() is not None, timeout=20, msg="daemon should exit")
+    assert n2.poll() == 0, f"drain exit code {n2.poll()}"
+    # new work must not land there (it CAN'T — node gone); spillback
+    # and scheduling keep working on the survivors
+    spots = set(ray_tpu.get([where.remote() for _ in range(8)], timeout=120))
+    assert nid2 not in spots
+
+
+def test_drained_actor_restart_consumes_no_budget(drain_cluster):
+    """Actor restarts caused by a drain are budget-free: a max_restarts=1
+    actor survives a drain AND still has its one crash-restart left.
+    (Module grace is 3.0s: a plain actor never exits on its own, so the
+    drain waits the full grace before deregistering.)"""
+    drain_cluster.add_node(num_cpus=2, resources={"p3": 2})
+    host_raw = wait_for_node_resource("p3")
+    host_nid = host_raw.hex() if isinstance(host_raw, bytes) else host_raw
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=4, num_cpus=0, resources={"p3": 1})
+    class A:
+        def pid(self):
+            return os.getpid()
+
+        def node(self):
             return ray_tpu.get_runtime_context().get_node_id()
 
-        # warm up: reach the pinned node at least once
-        nid2 = None
-        for _ in range(4):
-            nid = ray_tpu.get(where.options(resources={"pin": 1}).remote(), timeout=60)
-            nid2 = nid
-        assert nid2 is not None
-        assert ray_tpu.drain_node(nid2, "test: scheduling exclusion")
-        # the daemon drains (idle) and deregisters: entry goes DEAD, no
-        # ghost DRAINING row, process exits 0
-        _wait(
-            lambda: _node_rows()[nid2]["State"] == "DEAD",
-            timeout=30,
-            msg="drained node should deregister to DEAD",
-        )
-        _wait(lambda: n2.poll() is not None, timeout=20, msg="daemon should exit")
-        assert n2.poll() == 0, f"drain exit code {n2.poll()}"
-        # new work must not land there (it CAN'T — node gone); spillback
-        # and scheduling keep working on the survivors
-        spots = set(ray_tpu.get([where.remote() for _ in range(8)], timeout=120))
-        assert nid2 not in spots
-    finally:
-        ray_tpu.shutdown()
-        cluster.shutdown()
+    a = A.remote()
+    pid1 = ray_tpu.get(a.pid.remote(), timeout=120)
+    nid = ray_tpu.get(a.node.remote(), timeout=60)
+    assert nid == host_nid
+    # replacement capacity first, then drain the hosting node
+    drain_cluster.add_node(num_cpus=2, resources={"p3": 2})
+    wait_for_node_resource("p3", exclude={host_raw})
+    assert ray_tpu.drain_node(nid, "test: budget-free restart")
+    _wait(
+        lambda: _node_rows()[nid]["State"] == "DEAD",
+        timeout=40,
+        msg="drained node deregisters",
+    )
+    deadline = time.time() + 90
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(a.pid.remote(), timeout=15)
+            break
+        except ray_tpu.RayTpuError:
+            time.sleep(1)
+    assert pid2 is not None and pid2 != pid1
+    # the drain restart consumed NO budget
+    from ray_tpu.core.api import _global_worker
+
+    be = _global_worker().backend
+    info = be.io.run(
+        be.controller.call("get_actor_info", {"actor_id": a.actor_id})
+    )
+    assert info["num_restarts"] == 0, info
+    # the one real crash-restart is still available
+    os.kill(pid2, signal.SIGKILL)
+    deadline = time.time() + 90
+    pid3 = None
+    while time.time() < deadline:
+        try:
+            pid3 = ray_tpu.get(a.pid.remote(), timeout=15)
+            break
+        except ray_tpu.RayTpuError:
+            time.sleep(1)
+    assert pid3 is not None and pid3 != pid2
+    info = be.io.run(
+        be.controller.call("get_actor_info", {"actor_id": a.actor_id})
+    )
+    assert info["num_restarts"] == 1, info
 
 
-def test_drained_actor_restart_consumes_no_budget():
-    """Actor restarts caused by a drain are budget-free: a max_restarts=1
-    actor survives a drain AND still has its one crash-restart left."""
-    from ray_tpu.core.config import GLOBAL_CONFIG
-
-    # short grace: a plain actor never exits on its own, so the drain
-    # waits the full grace before deregistering — 3s keeps the test fast
-    # without changing the semantics under test. Set BEFORE Cluster() so
-    # it serializes into the spawned daemons.
-    old_grace = GLOBAL_CONFIG.drain_grace_s
-    GLOBAL_CONFIG.drain_grace_s = 3.0
-    cluster = Cluster(num_cpus=1)
-    cluster.add_node(num_cpus=2, resources={"pin": 2})
-    time.sleep(1.0)
-    ray_tpu.init(address=cluster.address)
-    try:
-
-        @ray_tpu.remote(max_restarts=1, max_task_retries=4, num_cpus=0, resources={"pin": 1})
-        class A:
-            def pid(self):
-                return os.getpid()
-
-            def node(self):
-                return ray_tpu.get_runtime_context().get_node_id()
-
-        a = A.remote()
-        pid1 = ray_tpu.get(a.pid.remote(), timeout=120)
-        nid = ray_tpu.get(a.node.remote(), timeout=60)
-        # replacement capacity first, then drain the hosting node
-        cluster.add_node(num_cpus=2, resources={"pin": 2})
-        time.sleep(1.0)
-        assert ray_tpu.drain_node(nid, "test: budget-free restart")
-        _wait(
-            lambda: _node_rows()[nid]["State"] == "DEAD",
-            timeout=40,
-            msg="drained node deregisters",
-        )
-        deadline = time.time() + 90
-        pid2 = None
-        while time.time() < deadline:
-            try:
-                pid2 = ray_tpu.get(a.pid.remote(), timeout=15)
-                break
-            except ray_tpu.RayTpuError:
-                time.sleep(1)
-        assert pid2 is not None and pid2 != pid1
-        # the drain restart consumed NO budget
-        from ray_tpu.core.api import _global_worker
-
-        be = _global_worker().backend
-        info = be.io.run(
-            be.controller.call("get_actor_info", {"actor_id": a.actor_id})
-        )
-        assert info["num_restarts"] == 0, info
-        # the one real crash-restart is still available
-        os.kill(pid2, signal.SIGKILL)
-        deadline = time.time() + 90
-        pid3 = None
-        while time.time() < deadline:
-            try:
-                pid3 = ray_tpu.get(a.pid.remote(), timeout=15)
-                break
-            except ray_tpu.RayTpuError:
-                time.sleep(1)
-        assert pid3 is not None and pid3 != pid2
-        info = be.io.run(
-            be.controller.call("get_actor_info", {"actor_id": a.actor_id})
-        )
-        assert info["num_restarts"] == 1, info
-    finally:
-        GLOBAL_CONFIG.drain_grace_s = old_grace
-        ray_tpu.shutdown()
-        cluster.shutdown()
-
-
-def test_drain_flushes_objects_off_node():
+def test_drain_flushes_objects_off_node(drain_cluster):
     """Primary copies on a drained node are replicated to a peer and
     remain gettable afterwards WITHOUT lineage reconstruction (the
     producing task cannot re-run: it was a one-shot put). INLINE results
     take the opposite path: they never enter the relocation machinery —
     the directory holds nothing for them and is never consulted; get()
     answers from the owner-side inline cache after the node is gone."""
-    cluster = Cluster(num_cpus=1)
-    n2 = cluster.add_node(num_cpus=2, resources={"pin": 2})
-    time.sleep(1.0)
-    ray_tpu.init(address=cluster.address)
-    try:
+    n2 = drain_cluster.add_node(num_cpus=2, resources={"p4": 2})
+    nid = wait_for_node_resource("p4")
 
-        @ray_tpu.remote(num_cpus=0, resources={"pin": 1}, max_retries=0)
-        def big_block(i):
-            # large enough to live in shm (not inlined in the reply)
-            return bytes([i]) * (512 * 1024)
+    @ray_tpu.remote(num_cpus=0, resources={"p4": 1}, max_retries=0)
+    def big_block(i):
+        # large enough to live in shm (not inlined in the reply)
+        return bytes([i]) * (512 * 1024)
 
-        @ray_tpu.remote(num_cpus=0, resources={"pin": 1}, max_retries=0)
-        def small(i):
-            return bytes([i]) * 64  # inline: rides back in the reply
+    @ray_tpu.remote(num_cpus=0, resources={"p4": 1}, max_retries=0)
+    def small(i):
+        return bytes([i]) * 64  # inline: rides back in the reply
 
-        nid = [
-            n["NodeID"] for n in ray_tpu.nodes() if "pin" in n["Resources"]
-        ][0]
-        refs = [big_block.remote(i) for i in range(4)]
-        inline_refs = [small.remote(i) for i in range(4)]
-        ray_tpu.wait(
-            refs + inline_refs,
-            num_returns=len(refs) + len(inline_refs),
-            timeout=120,
-            fetch_local=False,
-        )
-        assert ray_tpu.drain_node(nid, "test: object flush")
-        _wait(lambda: n2.poll() is not None, timeout=40, msg="daemon exits")
-        # max_retries=0: lineage reconstruction is OFF for these tasks —
-        # only the drain-time replication can satisfy these gets
-        vals = ray_tpu.get(refs, timeout=120)
-        assert [v[:1] for v in vals] == [bytes([i]) for i in range(4)]
-        assert all(len(v) == 512 * 1024 for v in vals)
-        # inline results: nothing was replicated for these ids…
-        from ray_tpu.core.api import _global_worker
+    refs = [big_block.remote(i) for i in range(4)]
+    inline_refs = [small.remote(i) for i in range(4)]
+    ray_tpu.wait(
+        refs + inline_refs,
+        num_returns=len(refs) + len(inline_refs),
+        timeout=120,
+        fetch_local=False,
+    )
+    assert ray_tpu.drain_node(nid, "test: object flush")
+    _wait(lambda: n2.poll() is not None, timeout=40, msg="daemon exits")
+    # max_retries=0: lineage reconstruction is OFF for these tasks —
+    # only the drain-time replication can satisfy these gets
+    vals = ray_tpu.get(refs, timeout=120)
+    assert [v[:1] for v in vals] == [bytes([i]) for i in range(4)]
+    assert all(len(v) == 512 * 1024 for v in vals)
+    # inline results: nothing was replicated for these ids…
+    from ray_tpu.core.api import _global_worker
 
-        core = _global_worker().backend
-        for r in inline_refs:
-            assert (
-                core.io.run(
-                    core.controller.call(
-                        "get_relocated", {"object_id": r.id().binary()}, timeout=10
-                    )
+    core = _global_worker().backend
+    for r in inline_refs:
+        assert (
+            core.io.run(
+                core.controller.call(
+                    "get_relocated", {"object_id": r.id().binary()}, timeout=10
                 )
-                is None
             )
+            is None
+        )
 
-        def relocated_consults():
-            stats = core.io.run(core.controller.call("event_stats", None, timeout=10))
-            return stats["handlers"].get("get_relocated", {}).get("count", 0)
+    def relocated_consults():
+        stats = core.io.run(core.controller.call("event_stats", None, timeout=10))
+        return stats["handlers"].get("get_relocated", {}).get("count", 0)
 
-        # …and their gets are served from the owner inline cache without
-        # a single relocation-directory consult
-        before = relocated_consults()
-        assert ray_tpu.get(inline_refs, timeout=60) == [
-            bytes([i]) * 64 for i in range(4)
-        ]
-        assert relocated_consults() == before
-    finally:
-        ray_tpu.shutdown()
-        cluster.shutdown()
+    # …and their gets are served from the owner inline cache without
+    # a single relocation-directory consult
+    before = relocated_consults()
+    assert ray_tpu.get(inline_refs, timeout=60) == [
+        bytes([i]) * 64 for i in range(4)
+    ]
+    assert relocated_consults() == before
 
 
-def test_preemption_mid_training_resumes_from_urgent_checkpoint():
+def test_preemption_mid_training_resumes_from_urgent_checkpoint(drain_cluster):
     """End-to-end chaos: a PreemptionKiller takes out the training node
     (warning → SIGKILL after grace) mid-run; the warning triggers an
     urgent checkpoint, the AUTOSCALER provisions the replacement (a
     DRAINING node counts as unmet demand, and a fully-draining launch
     group stops counting against max_workers), the gang restarts there,
-    and the run completes having lost no more than steps-since-warning."""
+    and the run completes having lost no more than steps-since-warning.
+    (The gang needs the autoscaler-only "trainer" resource, so leftover
+    sacrificial nodes from earlier tests can never host it.)"""
     from ray_tpu.autoscaler import (
         AutoscalerConfig,
         FakeMultiNodeProvider,
@@ -257,9 +269,9 @@ def test_preemption_mid_training_resumes_from_urgent_checkpoint():
     # loaded box (same deflake as test_autoscaler.py)
     old_patience = GLOBAL_CONFIG.infeasible_fail_after_s
     GLOBAL_CONFIG.infeasible_fail_after_s = 90.0
-    cluster = Cluster(num_cpus=1)
-    ray_tpu.init(address=cluster.address)
-    provider = FakeMultiNodeProvider(f"127.0.0.1:{cluster.controller_port}")
+    provider = FakeMultiNodeProvider(
+        f"127.0.0.1:{drain_cluster.controller_port}"
+    )
     autoscaler = StandardAutoscaler(
         provider,
         AutoscalerConfig(
@@ -317,7 +329,7 @@ def test_preemption_mid_training_resumes_from_urgent_checkpoint():
                 failure_config=FailureConfig(max_failures=3),
             ),
         )
-        killer = PreemptionKiller(cluster, grace_s=4.0)
+        killer = PreemptionKiller(drain_cluster, grace_s=4.0)
 
         import threading
 
@@ -356,39 +368,32 @@ def test_preemption_mid_training_resumes_from_urgent_checkpoint():
     finally:
         autoscaler.stop()
         GLOBAL_CONFIG.infeasible_fail_after_s = old_patience
-        try:
-            provider.shutdown()
-        finally:
-            ray_tpu.shutdown()
-            cluster.shutdown()
+        provider.shutdown()
 
 
-def test_serve_drain_zero_failed_requests():
+def test_serve_drain_zero_failed_requests(drain_cluster):
     """A replica's node is preempted (warning → SIGKILL) under a steady
     request stream: the drain handoff (unroute → finish in-flight →
     replacement) keeps every request answered — zero client errors."""
-    cluster = Cluster(num_cpus=2)
-    n2 = cluster.add_node(num_cpus=2, resources={"serve": 2})
-    time.sleep(1.0)
-    ray_tpu.init(address=cluster.address)
+    n2 = drain_cluster.add_node(num_cpus=2, resources={"srv": 2})
+    nid2 = wait_for_node_resource("srv")
+    from ray_tpu import serve
+
+    @serve.deployment(
+        num_replicas=2,
+        ray_actor_options={"num_cpus": 0.25, "resources": {"srv": 1}},
+    )
+    class Echo:
+        def __call__(self, x):
+            time.sleep(0.05)
+            return x
+
+    # one replica per "srv" slot: extra capacity so the drained
+    # replica has somewhere to respawn
+    drain_cluster.add_node(num_cpus=2, resources={"srv": 2})
+    wait_for_node_resource("srv", exclude={nid2})
+    handle = serve.run(Echo.bind())
     try:
-        from ray_tpu import serve
-
-        @serve.deployment(
-            num_replicas=2,
-            ray_actor_options={"num_cpus": 0.25, "resources": {"serve": 1}},
-        )
-        class Echo:
-            def __call__(self, x):
-                time.sleep(0.05)
-                return x
-
-        # one replica per "serve" slot: put capacity on the head too so
-        # the drained replica has somewhere to respawn
-        cluster.add_node(num_cpus=2, resources={"serve": 2})
-        time.sleep(1.0)
-        handle = serve.run(Echo.bind())
-
         import threading
 
         results, errors = [], []
@@ -408,7 +413,7 @@ def test_serve_drain_zero_failed_requests():
         t.start()
         try:
             time.sleep(0.5)
-            killer = PreemptionKiller(cluster, grace_s=5.0)
+            killer = PreemptionKiller(drain_cluster, grace_s=5.0)
             killer.preempt(n2)  # blocks for the grace, then SIGKILLs
             # stream keeps flowing across the handoff + replacement
             time.sleep(2.0)
@@ -428,52 +433,51 @@ def test_serve_drain_zero_failed_requests():
             timeout=150,
         )
         assert st and st["replicas"] == 2, st
+    finally:
         serve.delete("Echo")
         serve.shutdown()
-    finally:
-        ray_tpu.shutdown()
-        cluster.shutdown()
 
 
-def test_drain_grace_expiry_falls_back_to_abrupt_death():
+def test_drain_grace_expiry_falls_back_to_abrupt_death(drain_cluster):
     """A task that outlives the drain grace: the SIGKILL lands on a
     still-running node, the controller detects the death through the
     normal health-check path, and the task is retried elsewhere."""
-    cluster = Cluster(num_cpus=2)
-    n2 = cluster.add_node(num_cpus=2, resources={"pin": 2})
-    time.sleep(1.0)
-    ray_tpu.init(
-        address=cluster.address,
+    n2 = drain_cluster.add_node(num_cpus=2, resources={"stub": 2})
+    stub_raw = wait_for_node_resource("stub")
+    stub_nid = stub_raw.hex() if isinstance(stub_raw, bytes) else stub_raw
+
+    @ray_tpu.remote(num_cpus=0.5, max_retries=2)
+    def stubborn(path):
+        # runs way past any drain grace the killer allows; the retry
+        # (on a surviving node) finds the marker and returns fast
+        if os.path.exists(path):
+            return "retried"
+        open(path, "w").close()
+        time.sleep(300)
+        return "finished"
+
+    marker = f"/tmp/ray_tpu_drain_marker_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    # pin the first execution to the doomed node
+    ref = stubborn.options(resources={"stub": 1}).remote(marker)
+    _wait(lambda: os.path.exists(marker), timeout=60, msg="task started")
+    killer = PreemptionKiller(drain_cluster, grace_s=2.0)
+    killer.preempt(n2)  # grace far shorter than the task: abrupt kill
+    assert killer.kills == 1
+    # retry must run somewhere else (the stub resource died with the
+    # node) — drop the constraint by retrying through task retry:
+    # the spec keeps its stub pin, so a replacement node supplies it
+    drain_cluster.add_node(num_cpus=2, resources={"stub": 2})
+    assert ray_tpu.get(ref, timeout=180) == "retried"
+    # the abrupt-death half of the contract: the controller's health
+    # check must flip the SIGKILLed (never-deregistered) DRAINING row
+    # to DEAD — no ghost entry survives
+    _wait(
+        lambda: _node_rows()[stub_nid]["State"] == "DEAD",
+        timeout=30,
+        msg="killed draining node should be health-checked to DEAD",
     )
-    try:
-
-        @ray_tpu.remote(num_cpus=0.5, max_retries=2)
-        def stubborn(path):
-            # runs way past any drain grace the killer allows; the retry
-            # (on a surviving node) finds the marker and returns fast
-            if os.path.exists(path):
-                return "retried"
-            open(path, "w").close()
-            time.sleep(300)
-            return "finished"
-
-        marker = f"/tmp/ray_tpu_drain_marker_{os.getpid()}"
-        if os.path.exists(marker):
-            os.unlink(marker)
-
-        # pin the first execution to the doomed node
-        ref = stubborn.options(resources={"pin": 1}).remote(marker)
-        _wait(lambda: os.path.exists(marker), timeout=60, msg="task started")
-        killer = PreemptionKiller(cluster, grace_s=2.0)
-        killer.preempt(n2)  # grace far shorter than the task: abrupt kill
-        assert killer.kills == 1
-        # retry must run somewhere else (the pin resource died with the
-        # node) — drop the constraint by retrying through task retry:
-        # the spec keeps its pin, so a replacement node supplies it
-        cluster.add_node(num_cpus=2, resources={"pin": 2})
-        assert ray_tpu.get(ref, timeout=180) == "retried"
-        if os.path.exists(marker):
-            os.unlink(marker)
-    finally:
-        ray_tpu.shutdown()
-        cluster.shutdown()
+    if os.path.exists(marker):
+        os.unlink(marker)

@@ -47,6 +47,41 @@ def params(cfg):
     return init_params(cfg, jax.random.PRNGKey(0))
 
 
+#: env-armed kill plan for the module's ONE shared cluster: every
+#: runtime process (daemons -> workers, incl. controller-spawned
+#: replacements) inherits it; the driver's GLOBAL_CONFIG stays clean
+#: (env is only read at import), so driver-local reference engines
+#: never consult it
+CHAOS_SPEC, CHAOS_SEED = "kill_mid_decode:1.0:6", 20260804
+
+
+@pytest.fixture(scope="module")
+def chaos_cluster():
+    """One cluster for both E2E chaos tests — cluster boot/teardown was
+    the dominant suite cost of this module. The kill plan must be in
+    the env BEFORE init (daemons capture it for every worker they
+    spawn), which makes it module-wide: each test must stay inside the
+    per-process kill window it implies (see the stall test's note)."""
+    import os
+
+    os.environ["RAY_TPU_testing_replica_chaos"] = CHAOS_SPEC
+    os.environ["RAY_TPU_testing_replica_chaos_seed"] = str(CHAOS_SEED)
+    ray_tpu.init(num_cpus=4)
+    try:
+        yield
+    finally:
+        # the plan must not outlive this module: a later module's
+        # cluster would inherit it and keep dying
+        os.environ.pop("RAY_TPU_testing_replica_chaos", None)
+        os.environ.pop("RAY_TPU_testing_replica_chaos_seed", None)
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        GLOBAL_CONFIG.testing_replica_chaos = ""
+        GLOBAL_CONFIG.testing_replica_chaos_seed = 0
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
 def _engine(cfg, params, **overrides):
     kw = dict(
         num_blocks=64, block_size=8, prefill_buckets=(8, 32),
@@ -227,7 +262,9 @@ def test_resume_after_delivered_eos_emits_nothing(cfg, params):
 
 
 @pytest.mark.chaos
-def test_e2e_hot_replica_killed_mid_decode_byte_exact(cfg, params):
+def test_e2e_hot_replica_killed_mid_decode_byte_exact(
+    cfg, params, chaos_cluster
+):
     """ISSUE 10 acceptance gate: a seeded ReplicaFaultPlan SIGKILLs the
     affinity-hot replica mid-decode under 8 concurrent streams; every
     client receives the byte-exact token sequence of an undisturbed run
@@ -240,7 +277,7 @@ def test_e2e_hot_replica_killed_mid_decode_byte_exact(cfg, params):
     from ray_tpu.observability import slo as _slo
     from ray_tpu.observability.rpc_metrics import STREAM_RESUME_REPLAY_TOKENS
 
-    SPEC, SEED = "kill_mid_decode:1.0:6", 20260804
+    SPEC, SEED = CHAOS_SPEC, CHAOS_SEED
     ec = EngineConfig(
         num_blocks=64, block_size=8, prefill_buckets=(8, 32),
         decode_buckets=(1, 8), max_decode_batch=8, max_new_tokens_default=8,
@@ -250,9 +287,10 @@ def test_e2e_hot_replica_killed_mid_decode_byte_exact(cfg, params):
     prompts = {i: shared + [60 + i] for i in range(n)}
     # expected sequences from an undisturbed LOCAL engine with the same
     # params seed — byte-exactness across processes is exactly what
-    # deterministic continuation guarantees. Computed BEFORE init
-    # installs the chaos plan: the driver-local reference engine would
-    # otherwise consult it and SIGKILL the test process itself.
+    # deterministic continuation guarantees. Safe to compute with the
+    # module's kill plan armed in the ENV: the driver's GLOBAL_CONFIG
+    # read the env at import (long before the fixture exported the
+    # plan), so driver-local engines never consult it.
     ref = InferenceEngine(cfg, params, ec).start()
     try:
         expected = {
@@ -264,19 +302,13 @@ def test_e2e_hot_replica_killed_mid_decode_byte_exact(cfg, params):
         }
     finally:
         ref.stop()
-    # env-driven plan (the channel worker processes actually inherit:
-    # driver env -> daemon env -> worker env; system_config reaches only
-    # daemons): EVERY replica (incl. controller-spawned replacements)
-    # consults the same seeded schedule — deaths keep happening until
-    # streams outrun the per-process kill, which is the multi-death
-    # convergence the resume protocol must survive. The DRIVER's own
-    # GLOBAL_CONFIG stays clean (env is only read at import), so
-    # driver-local engines never consult the plan.
-    import os
-
-    os.environ["RAY_TPU_testing_replica_chaos"] = SPEC
-    os.environ["RAY_TPU_testing_replica_chaos_seed"] = str(SEED)
-    ray_tpu.init(num_cpus=4)
+    # the module cluster armed the env-driven plan before init (worker
+    # processes inherit: driver env -> daemon env -> worker env;
+    # system_config reaches only daemons): EVERY replica — including
+    # controller-spawned replacements — consults the same seeded
+    # schedule, so deaths keep happening until streams outrun the
+    # per-process kill: the multi-death convergence the resume protocol
+    # must survive.
     old_weight = GLOBAL_CONFIG.serve_affinity_weight
     GLOBAL_CONFIG.serve_affinity_weight = 1e6  # pin streams to the warm replica
     try:
@@ -410,68 +442,62 @@ def test_e2e_hot_replica_killed_mid_decode_byte_exact(cfg, params):
     finally:
         GLOBAL_CONFIG.trace_sample_rate = 0.0
         GLOBAL_CONFIG.serve_affinity_weight = old_weight
-        # the plan must not outlive this test: a later test's cluster
-        # (or a driver-local engine, had config been touched) would
-        # inherit it and keep dying
-        os.environ.pop("RAY_TPU_testing_replica_chaos", None)
-        os.environ.pop("RAY_TPU_testing_replica_chaos_seed", None)
-        GLOBAL_CONFIG.testing_replica_chaos = ""
-        GLOBAL_CONFIG.testing_replica_chaos_seed = 0
-        serve.shutdown()
-        ray_tpu.shutdown()
 
 
 @pytest.mark.chaos
-def test_stalled_replica_health_restarted_and_stream_resumes(cfg, params):
+def test_stalled_replica_health_restarted_and_stream_resumes(
+    cfg, params, chaos_cluster
+):
     """Health-restart tightening: a replica whose engine step loop
     STALLS (process alive, actor loop answering — liveness checks pass)
     is caught by the serve controller's replica.health() poll, killed
     with reason=unhealthy, and replaced; the interrupted stream resumes
-    on the replacement and still delivers the exact sequence."""
+    on the replacement and still delivers the exact sequence.
+
+    Shares the module cluster, so its replicas carry the env kill plan
+    too — deliberately survivable: the surgically-armed stall plan WINS
+    over the env plan on the stalled replica (its kill never fires
+    there), and the replacement's resumed tail is at most 6 decode
+    consults, inside the env plan's 6-consult skip window."""
     ec = EngineConfig(
         num_blocks=64, block_size=8, prefill_buckets=(8, 32),
         decode_buckets=(1, 4), max_decode_batch=4,
         max_new_tokens_default=8,
         step_stall_unhealthy_s=1.0,  # fast wedge detection for the test
     )
-    ray_tpu.init(num_cpus=4)
+    dep = serve.llm_deployment(
+        cfg, engine=ec, name="llmst", num_replicas=1,
+        route_prefix="/llmst", ray_actor_options={"num_cpus": 0.25},
+    )
+    handle = serve.run(dep.bind())
+    ctrl = ray_tpu.get_actor("__serve_controller__")
+    replicas = ray_tpu.get(ctrl.get_replicas.remote("llmst"), timeout=60)
+    assert len(replicas) == 1
+    # surgical plan on THE replica (not env-wide: the replacement
+    # must come up clean): first consult stalls 30s, once
+    ray_tpu.get(
+        replicas[0].handle_request.remote(
+            "testing_arm_replica_chaos", ["stall:1.0:30.0:1", 5], {}, ""
+        ),
+        timeout=60,
+    )
+    prompt = [4, 8, 1, 9]
+    ref = InferenceEngine(cfg, params, ec).start()
     try:
-        dep = serve.llm_deployment(
-            cfg, engine=ec, name="llmst", num_replicas=1,
-            route_prefix="/llmst", ray_actor_options={"num_cpus": 0.25},
-        )
-        handle = serve.run(dep.bind())
-        ctrl = ray_tpu.get_actor("__serve_controller__")
-        replicas = ray_tpu.get(ctrl.get_replicas.remote("llmst"), timeout=60)
-        assert len(replicas) == 1
-        # surgical plan on THE replica (not env-wide: the replacement
-        # must come up clean): first consult stalls 30s, once
-        ray_tpu.get(
-            replicas[0].handle_request.remote(
-                "testing_arm_replica_chaos", ["stall:1.0:30.0:1", 5], {}, ""
-            ),
-            timeout=60,
-        )
-        prompt = [4, 8, 1, 9]
-        ref = InferenceEngine(cfg, params, ec).start()
-        try:
-            expected = list(ref.generate(prompt, max_new_tokens=6))
-        finally:
-            ref.stop()
-        t0 = time.monotonic()
-        toks = list(handle.stream(
-            {"prompt": prompt, "max_new_tokens": 6},
-            _method="generate", _timeout=180,
-        ))
-        assert toks == expected
-        # the stream finished LONG before the 30s stall could have
-        # released it — only a proactive restart explains that
-        assert time.monotonic() - t0 < 28, "stream waited out the stall"
-        st = ray_tpu.get(
-            ctrl.wait_status.remote("llmst", min_replicas=1, timeout_s=60),
-            timeout=90,
-        )
-        assert st["restarts"]["unhealthy"] >= 1, st
+        expected = list(ref.generate(prompt, max_new_tokens=6))
     finally:
-        serve.shutdown()
-        ray_tpu.shutdown()
+        ref.stop()
+    t0 = time.monotonic()
+    toks = list(handle.stream(
+        {"prompt": prompt, "max_new_tokens": 6},
+        _method="generate", _timeout=180,
+    ))
+    assert toks == expected
+    # the stream finished LONG before the 30s stall could have
+    # released it — only a proactive restart explains that
+    assert time.monotonic() - t0 < 28, "stream waited out the stall"
+    st = ray_tpu.get(
+        ctrl.wait_status.remote("llmst", min_replicas=1, timeout_s=60),
+        timeout=90,
+    )
+    assert st["restarts"]["unhealthy"] >= 1, st

@@ -1,0 +1,340 @@
+"""ISSUE 17 chaos gate: SIGKILL-mid-stream resume via the cluster-wide
+KV prefix tier (the serve-E2E companion to tests/test_kv_tier.py, which
+holds the unit/engine/server layers — these two tests are the only ones
+needing a real cluster, so they live with the other stream-resume E2E
+suites instead of paying cluster boot inside the alphabetically-early
+kv-tier module).
+
+* plan DISABLED: the hot replica is SIGKILLed mid-decode; every stream
+  is byte-exact, the resumes go through TIER FAULT-IN (replay-token
+  counter does NOT move), and the controller-spawned replacement boots
+  WARM from the daemon tier registry;
+* plan ARMED (missing_block prob 1.0): every survivor-side tier fetch
+  fails, the counted fallback ladder lands on PR 10 prefix replay, and
+  the streams are byte-exact anyway — reproducible from the one master
+  chaos seed.
+"""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.util.chaos import KvTierFaultPlan, derive_plan_seed
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+
+from ray_tpu.inference.engine import EngineConfig, InferenceEngine  # noqa: E402
+from ray_tpu.inference.kv_cache import _chain_digest  # noqa: E402
+from ray_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+
+#: 24 tokens = 3 full blocks at block_size 8
+SHARED = [12, 7, 3, 9, 1, 5, 2, 8] * 3
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _digests(tokens, bs=8):
+    """Full-block chain digests of ``tokens`` (the tier's key space)."""
+    out, prev = [], b""
+    for end in range(bs, len(tokens) + 1, bs):
+        prev = _chain_digest(prev, tokens[end - bs : end])
+        out.append(prev)
+    return out
+
+
+def _ec_cluster():
+    return EngineConfig(
+        num_blocks=64, block_size=8, prefill_buckets=(8, 32),
+        decode_buckets=(1, 4), max_decode_batch=4,
+        max_new_tokens_default=8, warmup=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tier_cluster():
+    ray_tpu.init(num_cpus=4)
+    dep = serve.llm_deployment(
+        LlamaConfig.tiny(), engine=_ec_cluster(), name="llmtier",
+        num_replicas=2, kv_tier=True, route_prefix="/llmtier",
+        ray_actor_options={"num_cpus": 0.25},
+    )
+    handle = serve.run(dep.bind())
+    ctrl = ray_tpu.get_actor("__serve_controller__")
+    ray_tpu.get(
+        ctrl.wait_status.remote("llmtier", min_replicas=2, timeout_s=90),
+        timeout=120,
+    )
+    yield handle
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _controller():
+    return ray_tpu.get_actor("__serve_controller__")
+
+
+def _replicas(name="llmtier"):
+    return ray_tpu.get(_controller().get_replicas.remote(name), timeout=60)
+
+
+def _replica_call(replica, method, args=(), timeout=60):
+    return ray_tpu.get(
+        replica.handle_request.remote(method, list(args), {}, ""),
+        timeout=timeout,
+    )
+
+
+def _replica_metrics(replica) -> str:
+    addr = _replica_call(replica, "metrics_address")
+    return urllib.request.urlopen(
+        f"http://{addr}/metrics", timeout=10
+    ).read().decode()
+
+
+def _scrape_total(name) -> float:
+    """Sum a counter family across every live replica's /metrics."""
+    total = 0.0
+    for rep in _replicas():
+        for line in _replica_metrics(rep).splitlines():
+            if line.startswith(name) and " " in line:
+                total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _warm_and_find_hot(handle, warm_prompt):
+    """Serve one short warm request, let gossip land, and return the
+    replica whose tier adverts GREW — the affinity-hot one."""
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    before = {
+        rep.actor_id:
+            len(_replica_call(rep, "routing_stats").get("kv_tier") or {})
+        for rep in _replicas()
+    }
+    list(handle.stream(
+        {"prompt": warm_prompt, "max_new_tokens": 2},
+        _method="generate", _timeout=120,
+    ))
+    time.sleep(3 * GLOBAL_CONFIG.serve_replica_stats_period_s)
+    hot = [
+        rep for rep in _replicas()
+        if len(_replica_call(rep, "routing_stats").get("kv_tier") or {})
+        > before.get(rep.actor_id, 0)
+    ]
+    assert len(hot) == 1, "warm request did not land on exactly one replica"
+    return hot[0]
+
+
+def _run_streams(handle, prompts, max_new, seed_base):
+    results, errors = {}, {}
+
+    def consume(i):
+        try:
+            results[i] = list(handle.stream(
+                {"prompt": prompts[i], "max_new_tokens": max_new,
+                 "temperature": 0.7, "seed": seed_base + i,
+                 "request_id": f"tier{i}"},
+                _method="generate", _timeout=180,
+            ))
+        except Exception as e:  # noqa: BLE001
+            errors[i] = e
+
+    threads = [
+        threading.Thread(target=consume, args=(i,)) for i in prompts
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    return results, errors
+
+
+@pytest.mark.chaos
+def test_e2e_sigkill_mid_decode_resumes_via_tier_fault_in(
+    tier_cluster, cfg, params
+):
+    """Chaos gate, plan DISABLED: the hot replica is SIGKILLed
+    mid-decode under 4 concurrent streams. Every client gets the
+    byte-exact sequence of an undisturbed run, the resumes went through
+    TIER FAULT-IN — `raytpu_stream_resume_replay_tokens_total` does not
+    grow (zero re-prefill of cached prefix), tier hit counters do —
+    and the controller-spawned replacement comes up WARM (its tier
+    adverts recovered from the daemon registry before serving)."""
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.observability.rpc_metrics import (
+        STREAM_RESUME_REPLAY_TOKENS, STREAM_RESUMES,
+    )
+
+    handle = tier_cluster
+    n, max_new = 4, 12
+    shared = SHARED
+    prompts = {i: shared + [60 + i] for i in range(n)}
+    ec = _ec_cluster()
+    ref = InferenceEngine(cfg, params, ec).start()
+    try:
+        expected = {
+            i: list(ref.generate(
+                prompts[i], max_new_tokens=max_new,
+                temperature=0.7, seed=100 + i,
+            ))
+            for i in range(n)
+        }
+    finally:
+        ref.stop()
+
+    old_weight = GLOBAL_CONFIG.serve_affinity_weight
+    GLOBAL_CONFIG.serve_affinity_weight = 1e6
+    try:
+        # warm request prefill-publishes the 3 shared blocks and ticks
+        # 2 decode consults on the hot replica's (about-to-be-armed)
+        # kill window; its gossip pins the streams via affinity
+        hot = _warm_and_find_hot(handle, shared + [42])
+        # surgical kill plan on the HOT replica ONLY (the survivor and
+        # the replacement must stay clean: this variant asserts replay
+        # == 0, which a cascade of deaths could not guarantee): 6 more
+        # decode consults, then SIGKILL — each stream has at most 7
+        # delivered tokens, so the 24 shared-prefix tier tokens always
+        # COVER the extended prompt (len <= 32 = 24 + block_size)
+        _replica_call(
+            hot, "testing_arm_replica_chaos", ["kill_mid_decode:1.0:4", 4242]
+        )
+        resumes_before = STREAM_RESUMES._values.get(("llmtier",), 0.0)
+        replay_before = STREAM_RESUME_REPLAY_TOKENS._values.get((), 0.0)
+        hits_before = _scrape_total("raytpu_kv_tier_hits_total")
+
+        results, errors = _run_streams(handle, prompts, max_new, 100)
+        assert not errors, errors
+        assert results == expected, {
+            i: (results.get(i), expected[i]) for i in range(n)
+            if results.get(i) != expected[i]
+        }
+        resumes = (
+            STREAM_RESUMES._values.get(("llmtier",), 0.0) - resumes_before
+        )
+        assert resumes > 0, "the kill never landed mid-stream"
+        # THE tentpole assert: failover went through tier fault-in, so
+        # the replay counter did not move — zero re-prefill of prefix
+        # the cluster already had
+        assert (
+            STREAM_RESUME_REPLAY_TOKENS._values.get((), 0.0) - replay_before
+            == 0.0
+        )
+        ctrl = _controller()
+        st = ray_tpu.get(
+            ctrl.wait_status.remote("llmtier", min_replicas=2, timeout_s=120),
+            timeout=150,
+        )
+        assert st["replicas"] == 2 and st["restarts"]["death"] >= 1, st
+        assert _scrape_total("raytpu_kv_tier_hits_total") > hits_before
+        # warm replica restart: EVERY live replica — including the
+        # replacement, which never served a shared-prefix request and
+        # can only have recovered them from the daemon's tier registry
+        # at boot — adverts the shared prefix chain
+        chain = {d.hex() for d in _digests(shared)}
+        for rep in _replicas():
+            adverts = _replica_call(rep, "routing_stats").get("kv_tier") or {}
+            assert chain <= set(adverts), (len(adverts), chain)
+    finally:
+        GLOBAL_CONFIG.serve_affinity_weight = old_weight
+
+
+# slow: the in-gate equivalents are test_e2e_sigkill_mid_decode_resumes_
+# via_tier_fault_in (same SIGKILL-mid-stream resume, tier path healthy)
+# plus test_kv_tier.py::test_tier_fault_in_across_servers_byte_exact
+# (the armed missing_block/corrupt_block ladder, counted fallback,
+# byte-exact) and test_kv_tier.py::
+# test_kv_tier_plan_derives_from_master_chaos_seed (schedule
+# reproducibility) — this variant composes the three at full E2E cost
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_e2e_sigkill_with_armed_tier_chaos_falls_back_byte_exact(
+    tier_cluster, cfg, params
+):
+    """Chaos gate, plan ARMED at prob 1.0: the same mid-decode SIGKILL,
+    but every survivor-side tier fetch fails (missing_block). The
+    fallback ladder is COUNTED and the streams land on PR 10 prefix
+    replay — byte-exact either way, and the tier plan's schedule
+    reproduces from the master chaos seed alone."""
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.observability.rpc_metrics import STREAM_RESUMES
+
+    handle = tier_cluster
+    n, max_new = 4, 12
+    shared = [9, 14, 6, 2, 11, 7, 13, 1] * 3  # fresh family: cold tier
+    prompts = {i: shared + [80 + i] for i in range(n)}
+    ec = _ec_cluster()
+    ref = InferenceEngine(cfg, params, ec).start()
+    try:
+        expected = {
+            i: list(ref.generate(
+                prompts[i], max_new_tokens=max_new,
+                temperature=0.7, seed=300 + i,
+            ))
+            for i in range(n)
+        }
+    finally:
+        ref.stop()
+
+    master = 20260806
+    tier_seed = derive_plan_seed(master, "kv_tier")
+    old_weight = GLOBAL_CONFIG.serve_affinity_weight
+    GLOBAL_CONFIG.serve_affinity_weight = 1e6
+    try:
+        hot = _warm_and_find_hot(handle, shared + [43])
+        # arm the tier plan on EVERY live replica (the resume target is
+        # whichever survives), then the kill plan on the hot one
+        for rep in _replicas():
+            got = _replica_call(
+                rep, "testing_arm_kv_tier_chaos",
+                ["missing_block:1.0:0:99", tier_seed],
+            )
+            assert got == tier_seed
+        _replica_call(
+            hot, "testing_arm_replica_chaos", ["kill_mid_decode:1.0:4", 777]
+        )
+        resumes_before = STREAM_RESUMES._values.get(("llmtier",), 0.0)
+        fb_before = _scrape_total("raytpu_kv_tier_fallbacks_total")
+
+        results, errors = _run_streams(handle, prompts, max_new, 300)
+        assert not errors, errors
+        assert results == expected, {
+            i: (results.get(i), expected[i]) for i in range(n)
+            if results.get(i) != expected[i]
+        }
+        assert (
+            STREAM_RESUMES._values.get(("llmtier",), 0.0) - resumes_before > 0
+        )
+        # the ladder fired and was counted on the survivor
+        assert _scrape_total("raytpu_kv_tier_fallbacks_total") > fb_before
+        # master-seed reproducibility: the armed seed derives from the
+        # one logged master, and the derived plan's schedule is a pure
+        # function of it
+        p1 = KvTierFaultPlan("missing_block:1.0:0:99", tier_seed)
+        p2 = KvTierFaultPlan(
+            "missing_block:1.0:0:99", derive_plan_seed(master, "kv_tier")
+        )
+        phases = ["fault_in"] * 8
+        s1 = [p1.consult(p) for p in phases]
+        assert s1 == [p2.consult(p) for p in phases]
+        assert ("missing_block", 0.0) in s1
+    finally:
+        GLOBAL_CONFIG.serve_affinity_weight = old_weight
+        for rep in _replicas():
+            try:
+                _replica_call(rep, "testing_arm_kv_tier_chaos", ["", 0])
+            except Exception:  # noqa: BLE001
+                pass
