@@ -1411,9 +1411,13 @@ class NodeDaemon:
 
     def _kv_tier_sweep(self) -> None:
         """TTL + cap eviction for tier entries (called from _reap_loop).
-        The tier is a cache: entries nobody faulted in for
-        kv_tier_ttl_s, or beyond kv_tier_max_entries (oldest-use first),
-        are dropped with their objects."""
+        The tier is a cache: entries nobody faulted in for kv_tier_ttl_s
+        expire unconditionally; past kv_tier_max_entries the victim is
+        chosen by POPULARITY — lowest hit count first, oldest recency
+        among ties — not pure insertion age. A shared system-prompt
+        prefix that every request faults in must outlive a parade of
+        colder, newer one-off entries, or the cap turns the tier into a
+        FIFO that evicts exactly its most valuable bytes."""
         now = time.monotonic()
         if now - self._last_kv_tier_sweep < 1.0:
             return
@@ -1424,28 +1428,44 @@ class NodeDaemon:
             self._kv_tier_drop_locked(digest)
         cap = max(1, GLOBAL_CONFIG.kv_tier_max_entries)
         while len(self._kv_tier) > cap:
-            self._kv_tier_drop_locked(next(iter(self._kv_tier)))
+            victim, best = None, None
+            # O(n) scan per eviction: the OrderedDict's order IS the
+            # recency axis (get/put move_to_end), so position breaks
+            # hit-count ties toward the longest-unused entry. Bounded
+            # by the 1s sweep throttle + the entry cap.
+            for i, (d, ent) in enumerate(self._kv_tier.items()):
+                score = (ent.get("hits", 0), i)
+                if best is None or score < best:
+                    victim, best = d, score
+            self._kv_tier_drop_locked(victim)
 
     async def d_kv_tier_put(self, payload, conn):
         """Register one tier entry (the object itself was already
         published + adopted through the normal store path — this call
-        transfers LIFETIME ownership to the daemon's registry)."""
+        transfers LIFETIME ownership to the daemon's registry). A re-put
+        of a live digest is a USE signal (some replica re-derived the
+        same prefix): it bumps the hit count the sweep's popularity
+        eviction keys on."""
         digest = str(payload["digest"])
+        prev = self._kv_tier.get(digest)
         self._kv_tier[digest] = {
             "desc": payload["desc"],
             "expiry": time.monotonic() + GLOBAL_CONFIG.kv_tier_ttl_s,
+            "hits": (prev["hits"] + 1) if prev else 0,
         }
         self._kv_tier.move_to_end(digest)
         self._kv_tier_sweep()
         return True
 
     async def d_kv_tier_get(self, payload, conn):
-        """Lookup one entry; a hit refreshes TTL + recency (a faulted-in
-        prefix is by definition still hot)."""
+        """Lookup one entry; a hit refreshes TTL + recency and bumps the
+        popularity count (a faulted-in prefix is by definition still
+        hot — hit-weighted cap eviction keeps it past colder entries)."""
         ent = self._kv_tier.get(str(payload["digest"]))
         if ent is None:
             return None
         ent["expiry"] = time.monotonic() + GLOBAL_CONFIG.kv_tier_ttl_s
+        ent["hits"] = ent.get("hits", 0) + 1
         self._kv_tier.move_to_end(str(payload["digest"]))
         return ent["desc"]
 

@@ -48,6 +48,20 @@ STREAM_PUSH_CHANNEL = 10
 _END = object()
 
 
+class TokenChunk(list):
+    """Marker for a COALESCED burst of stream items (serve token
+    streaming): a producer that has several items ready at once — e.g. a
+    speculative-decoding engine accepting k+1 tokens in one verify step
+    — yields them as one ``TokenChunk`` so the burst rides ONE
+    ObjectRef/get round trip instead of one per token. The serve router
+    flattens chunks before clients see them, so the consumer-visible
+    stream is unchanged; the subclass (not a bare list) is what lets the
+    router distinguish a coalesced burst from a deployment whose stream
+    legitimately yields list VALUES."""
+
+    __slots__ = ()
+
+
 def streaming_error_result(err) -> tuple:
     """The wire shape for a stream-level failure: streaming specs have no
     fixed return ids, so the empty-oid sentinel routes the error to the

@@ -216,6 +216,48 @@ class EngineConfig:
     #: Implies the gather/scatter programs (kv_transfer warmup). Off by
     #: default so plain deployments keep their exact compile count.
     kv_tier_enabled: bool = False
+    #: speculative decoding (inference/speculative.py): drafts proposed
+    #: per decode slot and verified in ONE bucketed jitted target step
+    #: (models.llama.paged_verify_step). 0 disables — plain deployments
+    #: keep their exact compile count (no verify bucket, no draft
+    #: runner). Acceptance is exact-match against the engine's own
+    #: deterministic (seed, absolute-position) sampler, so the emitted
+    #: stream is byte-identical to non-speculative decode and the
+    #: resumable-stream contract survives unchanged.
+    speculative_k: int = 0
+    #: draft mode: "ngram" (model-free prompt-lookup decoding, zero
+    #: device cost) or "model" (a scaled-down same-tokenizer draft model
+    #: on its own paged runner; requires draft_config)
+    speculative_draft: str = "ngram"
+    #: LlamaConfig for speculative_draft="model" (same vocab as the
+    #: target); ignored for "ngram"
+    draft_config: Any = None
+    #: draft model params (None → deterministic init from draft_config
+    #: with draft_seed)
+    draft_params: Any = None
+    draft_seed: int = 0
+    #: draft runner pool/buckets (0/None → scaled from the engine's own)
+    draft_num_blocks: int = 0
+    draft_prefill_buckets: Optional[Sequence[int]] = None
+    #: adaptive k: the 4 Hz gauge refresh shrinks the live draft budget
+    #: toward 1 while the windowed acceptance rate sits below the floor,
+    #: and grows it back toward speculative_k while acceptance is high —
+    #: the verify bucket stays fixed at speculative_k+1 (shorter windows
+    #: pad via true_len), so adaptation never recompiles
+    speculative_adaptive: bool = True
+    speculative_accept_floor: float = 0.35
+    #: prompt-lookup n-gram sizes for speculative_draft="ngram"
+    ngram_max: int = 3
+    ngram_min: int = 1
+
+    def resolved_verify_buckets(self) -> Sequence[int]:
+        """One verify bucket, sized for the full draft budget: k+1
+        window positions (last committed token + k drafts); shorter
+        windows (adaptive shrink, tail-of-request clamps) pad into it
+        via true_len instead of compiling new shapes."""
+        if self.speculative_k <= 0:
+            return ()
+        return (self.speculative_k + 1,)
 
     def resolved_prefill_buckets(self, max_seq_len: int) -> Sequence[int]:
         if self.prefill_buckets is not None:
@@ -245,6 +287,7 @@ class EngineConfig:
 def _engine_metrics():
     from ray_tpu.observability.metrics import Counter, Gauge
     from ray_tpu.observability.slo import slo_metrics
+    from ray_tpu.observability import rpc_metrics
 
     slo = slo_metrics()
     return {
@@ -295,6 +338,14 @@ def _engine_metrics():
             "raytpu_llm_cow_copies_total",
             "copy-on-write block duplications (full-prompt cache hits)",
         ),
+        # speculative decoding (defined in rpc_metrics so every process
+        # that imports the transport layer exports consistent help text;
+        # referencing them here puts them on the engine /metrics path
+        # and under the catalog lint)
+        "spec_proposed": rpc_metrics.LLM_SPEC_PROPOSED,
+        "spec_accepted": rpc_metrics.LLM_SPEC_ACCEPTED,
+        "spec_rollbacks": rpc_metrics.LLM_SPEC_ROLLBACKS,
+        "spec_acceptance": rpc_metrics.LLM_SPEC_ACCEPTANCE,
     }
 
 
@@ -331,6 +382,7 @@ class InferenceEngine:
             block_size=ec.block_size,
             prefill_buckets=ec.resolved_prefill_buckets(model_cfg.max_seq_len),
             decode_buckets=decode_buckets,
+            verify_buckets=ec.resolved_verify_buckets(),
             cache_dtype=ec.cache_dtype,
         )
         self.blocks = PagedBlockManager(
@@ -435,11 +487,73 @@ class InferenceEngine:
         self._migrate_on_drain = False
         if ec.kv_tier_enabled:
             self.blocks.set_spill_hook(self._tier_spill)
+        # -- speculative decoding (PR 19) --
+        #: the draft proposer (None when disabled). Only constructed for
+        #: speculative_k > 0, so plain engines keep their exact compile
+        #: count — the verify jit exists but holds zero cache entries.
+        self.spec = None
+        #: lifetime propose/accept/rollback books (stats() + adaptive k)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rollbacks = 0
+        #: (proposed, accepted) snapshot at the last gauge refresh — the
+        #: adaptive controller steers on the window delta, not lifetime
+        self._spec_window_seen = (0, 0)
+        self._spec_acceptance = 0.0
+        if ec.speculative_k > 0:
+            from ray_tpu.inference.speculative import (
+                DraftModelProposer,
+                NgramProposer,
+            )
+
+            if ec.speculative_draft == "model":
+                if ec.draft_config is None:
+                    raise ValueError(
+                        "speculative_draft='model' requires draft_config"
+                    )
+                draft_params = ec.draft_params
+                if draft_params is None:
+                    import jax
+
+                    from ray_tpu.models.llama import init_params
+
+                    draft_params = init_params(
+                        ec.draft_config, jax.random.PRNGKey(ec.draft_seed)
+                    )
+                self.spec = DraftModelProposer(
+                    ec.draft_config,
+                    draft_params,
+                    num_blocks=ec.draft_num_blocks or ec.num_blocks,
+                    block_size=ec.block_size,
+                    prefill_buckets=(
+                        tuple(ec.draft_prefill_buckets)
+                        if ec.draft_prefill_buckets is not None
+                        else ec.resolved_prefill_buckets(
+                            ec.draft_config.max_seq_len
+                        )
+                    ),
+                    cache_dtype=ec.cache_dtype,
+                )
+            elif ec.speculative_draft == "ngram":
+                self.spec = NgramProposer(
+                    max_ngram=ec.ngram_max, min_ngram=ec.ngram_min
+                )
+            else:
+                raise ValueError(
+                    f"unknown speculative_draft {ec.speculative_draft!r} "
+                    "(expected 'ngram' or 'model')"
+                )
+            self.scheduler.spec_max_context = model_cfg.max_seq_len
+            self.scheduler.spec_k_live = ec.speculative_k
         self.total_steps = 0
         if ec.warmup:
             self.runner.warmup(kv_io=ec.kv_transfer_enabled or ec.kv_tier_enabled)
+            if self.spec is not None and hasattr(self.spec, "warmup"):
+                self.spec.warmup()
         else:
             self.runner.mark_warm()
+            if self.spec is not None and hasattr(self.spec, "mark_warm"):
+                self.spec.mark_warm()
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "InferenceEngine":
@@ -532,6 +646,7 @@ class InferenceEngine:
         tenant_class: str = "",
         ledger_stages: Optional[Dict[str, float]] = None,
         record_slo: bool = True,
+        speculative: Optional[bool] = None,
     ) -> str:
         """Enqueue a generation request; returns its id. The ambient
         ``core.deadline`` budget (or explicit ``timeout_s``, whichever is
@@ -541,7 +656,11 @@ class InferenceEngine:
         ``ledger_stages`` carries stage durations measured upstream
         (e.g. the KV import that ran before this submit);
         ``record_slo=False`` keeps a resume attempt's warm-replay
-        latencies out of the SLO histograms (see Request.record_slo)."""
+        latencies out of the SLO histograms (see Request.record_slo).
+        ``speculative`` is the per-request off-switch: False forces
+        plain decode for this request even on a speculative engine
+        (True/None follow the engine config — output bytes are
+        identical either way, only throughput changes)."""
         if self._draining or not self.scheduler.admitting:
             raise EngineDrainingError("engine is draining: not admitting requests")
         prompt = [int(t) for t in prompt]
@@ -582,6 +701,13 @@ class InferenceEngine:
             tenant_class=str(tenant_class or ""),
             ledger_stages=dict(ledger_stages or {}),
             record_slo=bool(record_slo),
+            spec_k=(
+                self.engine_cfg.speculative_k
+                if self.spec is not None
+                and speculative is not False
+                and not prefill_only
+                else 0
+            ),
         )
         trace_wire = _tracing.current_wire()
         with self._lock:
@@ -620,6 +746,7 @@ class InferenceEngine:
         tenant_class: str = "",
         ledger_stages: Optional[Dict[str, float]] = None,
         record_slo: bool = True,
+        speculative: Optional[bool] = None,
     ) -> Iterator[int]:
         """Submit and stream tokens as they decode. Closing/abandoning
         the iterator cancels the request and frees its blocks."""
@@ -635,11 +762,71 @@ class InferenceEngine:
             tenant_class=tenant_class,
             ledger_stages=ledger_stages,
             record_slo=record_slo,
+            speculative=speculative,
         )
         try:
             yield from self.tokens(rid)
         finally:
             self.cancel(rid)  # no-op when already finished
+
+    def generate_chunks(self, prompt: Sequence[int], **kw) -> Iterator[List[int]]:
+        """:meth:`generate`, coalesced: yields LISTS — each the full
+        burst of tokens available at wake-up. Speculative decoding
+        commits up to k+1 tokens per verify step; draining the burst in
+        one item lets the serve streaming path pay its per-item cost
+        once per STEP instead of once per token (the router flattens, so
+        clients still see a per-token stream)."""
+        rid = self.submit(prompt, **kw)
+        try:
+            yield from self.tokens_chunked(rid)
+        finally:
+            self.cancel(rid)  # no-op when already finished
+
+    def tokens_chunked(
+        self, request_id: str, timeout: Optional[float] = None
+    ) -> Iterator[List[int]]:
+        """Chunked variant of :meth:`tokens`: one blocking wait per
+        burst, then a non-blocking drain of everything already queued.
+        Timeout/resume semantics match :meth:`tokens` (the timeout
+        bounds the wait for the NEXT burst)."""
+        q = self._out.get(request_id)
+        if q is None:
+            raise KeyError(f"unknown request {request_id!r}")
+        drop = True
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=timeout) if timeout is not None else q.get()
+                except queue.Empty:
+                    drop = False
+                    raise TimeoutError(
+                        f"no token within {timeout}s for request {request_id!r}; "
+                        "still running — retry tokens_chunked() or cancel()"
+                    ) from None
+                terminal = None
+                chunk: List[int] = []
+                while True:
+                    if item is _END or isinstance(item, Exception):
+                        terminal = item
+                        break
+                    chunk.append(item)
+                    try:
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        break
+                if chunk:
+                    yield chunk
+                if terminal is _END:
+                    return
+                if terminal is not None:
+                    raise terminal
+        finally:
+            # same queue-drop rule as tokens(): keep it on inter-token
+            # timeout so a retry can pick the stream back up
+            if drop:
+                with self._lock:
+                    self._out.pop(request_id, None)
+                    self._finished_at.pop(request_id, None)
 
     def tokens(self, request_id: str, timeout: Optional[float] = None) -> Iterator[int]:
         """Drain a submitted request's token stream. ``timeout`` bounds
@@ -828,16 +1015,46 @@ class InferenceEngine:
                     self._emit_token(req, self._sample(req, logits))
 
         if plan.decodes:
-            toks = [r.generated[-1] for r in plan.decodes]
-            poss = [r.context_len - 1 for r in plan.decodes]
-            rows = [
-                self.blocks.table_row(r.request_id, self.runner.max_blocks_per_seq)
-                for r in plan.decodes
-            ]
-            cls = [r.context_len for r in plan.decodes]
-            logits = self.runner.decode(toks, poss, rows, cls)
-            for req, lg in zip(plan.decodes, logits):
-                self._emit_token(req, self._sample(req, lg))
+            # speculative slots peel off the batch: each proposes drafts,
+            # then EVERY spec slot verifies in one batched target step
+            # (models.llama.paged_verify_step: B slots x k+1 positions
+            # per jit call). Slots whose proposer came up empty (no
+            # n-gram match, draft pool dry) ride the plain batched
+            # decode unchanged — speculation is an opportunistic
+            # throughput lever, never a dependency.
+            spec_slots: List[tuple] = []
+            plain: List[Request] = []
+            for r in plan.decodes:
+                drafts = self._spec_propose(r) if r.spec_step_k > 0 else []
+                if drafts:
+                    spec_slots.append((r, drafts))
+                else:
+                    plain.append(r)
+            if plain:
+                toks = [r.generated[-1] for r in plain]
+                poss = [r.context_len - 1 for r in plain]
+                rows = [
+                    self.blocks.table_row(
+                        r.request_id, self.runner.max_blocks_per_seq
+                    )
+                    for r in plain
+                ]
+                cls = [r.context_len for r in plain]
+                logits = self.runner.decode(toks, poss, rows, cls)
+                for req, lg in zip(plain, logits):
+                    self._emit_token(req, self._sample(req, lg))
+            if spec_slots:
+                windows = [[r.generated[-1]] + d for r, d in spec_slots]
+                rows = [
+                    self.blocks.table_row(
+                        r.request_id, self.runner.max_blocks_per_seq
+                    )
+                    for r, _ in spec_slots
+                ]
+                ctxs = [r.context_len - 1 for r, _ in spec_slots]
+                all_logits = self.runner.verify_batch(windows, rows, ctxs)
+                for (req, drafts), logits in zip(spec_slots, all_logits):
+                    self._spec_accept(req, drafts, logits)
         if n_prefill_tokens:
             self._prefill_token_times.append((time.monotonic(), n_prefill_tokens))
         self.total_steps += 1
@@ -853,6 +1070,75 @@ class InferenceEngine:
         )
         self._update_gauges(len(plan.decodes))
         return True
+
+    # -- speculative decoding (PR 19) -------------------------------------
+    def _spec_propose(self, req: Request) -> List[int]:
+        """Ask the proposer for up to ``spec_step_k`` drafts for this
+        slot. An empty proposal (nothing to look up, draft pool dry, a
+        broken proposer) degrades the slot to plain decode this step and
+        hands back the blocks the scheduler grew for the draft window."""
+        ctx = req.prompt + req.generated
+        try:
+            drafts = self.spec.propose(
+                ctx, req.spec_step_k, request_id=req.request_id
+            )
+        except Exception:  # noqa: BLE001 — proposer bugs must not kill steps
+            logger.exception("speculative proposer failed; plain decode")
+            drafts = []
+        drafts = [int(t) for t in list(drafts)[: req.spec_step_k]]
+        if not drafts:
+            self.blocks.trim_to(req.request_id, req.context_len)
+        return drafts
+
+    def _spec_accept(
+        self, req: Request, drafts: List[int], logits: np.ndarray
+    ) -> None:
+        """Commit the deterministically-accepted prefix of one slot's
+        verify window ``[last_committed, d_1..d_k']`` from its
+        all-position target logits (``logits[i]`` is the distribution
+        AFTER window position i; the batched verify already ran).
+
+        Acceptance is exact-match: at each window position the target's
+        token is realized with the engine's own (seed, absolute-position)
+        sampler (:meth:`_sample` — ``pos`` advances naturally as tokens
+        emit), drafts are accepted while they match it, and the first
+        mismatch position emits the target's token INSTEAD (the
+        bonus/correction token — every speculative step nets >= 1
+        token). Emitted bytes are therefore identical to plain decode by
+        construction, for greedy and seeded temperature>0 sampling
+        alike, and the proposer can never affect content — only the
+        acceptance rate.
+
+        Rollback is pure host-side accounting: ``generated`` only ever
+        received accepted tokens (the write cursor rewind is implicit),
+        and :meth:`PagedBlockManager.trim_to` hands back the blocks
+        grown past the committed context. The rejected tail's K/V stays
+        stale on device, unreachable by construction — every masked
+        read stops at the committed context length, and re-verification
+        overwrites the slots in place. The prefix index and the KV tier
+        only ever see positions below the verified cursor because both
+        derive from ``generated``."""
+        m = self.metrics
+        accepted = 0
+        for i in range(len(drafts) + 1):
+            if req.finished:
+                break
+            tok = self._sample(req, logits[i])
+            matched = i < len(drafts) and tok == drafts[i]
+            self._emit_token(req, tok)
+            if i < len(drafts):
+                if not matched:
+                    break
+                accepted += 1
+        self._spec_proposed += len(drafts)
+        self._spec_accepted += accepted
+        m["spec_proposed"].inc(len(drafts))
+        if accepted:
+            m["spec_accepted"].inc(accepted)
+        if accepted < len(drafts):
+            self._spec_rollbacks += 1
+            m["spec_rollbacks"].inc()
+        self.blocks.trim_to(req.request_id, req.context_len)
 
     # -- internals --------------------------------------------------------
     def _sample(self, req: Request, logits: np.ndarray) -> int:
@@ -1359,6 +1645,11 @@ class InferenceEngine:
 
     def _finish_request(self, req: Request, state: str, error: Optional[Exception]) -> None:
         outcome = {FINISHED: "finished", CANCELLED: "cancelled"}.get(state, "failed")
+        if self.spec is not None:
+            try:
+                self.spec.release(req.request_id)
+            except Exception:  # noqa: BLE001 — draft cleanup is best-effort
+                pass
         now = time.monotonic()
         with self._lock:
             q = self._out.get(req.request_id)
@@ -1585,6 +1876,29 @@ class InferenceEngine:
         m["queue_depth"].set(self.scheduler.queue_depth())
         m["active"].set(len(self.scheduler.running))
         m["tps"].set(round(self._tokens_per_s(), 2))
+        # adaptive speculative k rides the same 4 Hz refresh: steer the
+        # live draft budget on the acceptance rate measured since the
+        # last refresh window with enough proposals to mean something.
+        # Shrinking/growing k never recompiles — the verify bucket stays
+        # sized for speculative_k+1 and shorter windows pad via true_len.
+        if self.spec is not None:
+            prop, acc = self._spec_proposed, self._spec_accepted
+            d_prop = prop - self._spec_window_seen[0]
+            d_acc = acc - self._spec_window_seen[1]
+            if d_prop >= 8:
+                rate = d_acc / d_prop
+                self._spec_acceptance = rate
+                m["spec_acceptance"].set(round(rate, 4))
+                self._spec_window_seen = (prop, acc)
+                if self.engine_cfg.speculative_adaptive:
+                    k = (
+                        self.scheduler.spec_k_live
+                        or self.engine_cfg.speculative_k
+                    )
+                    if rate < self.engine_cfg.speculative_accept_floor and k > 1:
+                        self.scheduler.spec_k_live = k - 1
+                    elif rate >= 0.75 and k < self.engine_cfg.speculative_k:
+                        self.scheduler.spec_k_live = k + 1
 
     # -- introspection ----------------------------------------------------
     def set_deployment_name(self, name: str) -> None:
@@ -1622,17 +1936,36 @@ class InferenceEngine:
         return snap
 
     def stats(self) -> Dict[str, Any]:
+        # draft + verify buckets ride the same zero-recompile gate: a
+        # speculative engine's compile books count the draft runner too
+        spec_compiles = self.spec.compile_count() if self.spec is not None else 0
+        spec_recompiles = (
+            self.spec.recompiles_after_warmup() if self.spec is not None else 0
+        )
         s = {
             "scheduler": self.scheduler.stats(),
             "blocks": self.blocks.stats(),
             "prefix_cache": self.blocks.prefix_stats(),
             "total_steps": self.total_steps,
             "draining": self._draining,
-            "compile_count": self.runner.compile_count(),
-            "recompiles_after_warmup": self.runner.recompiles_after_warmup(),
+            "compile_count": self.runner.compile_count() + spec_compiles,
+            "recompiles_after_warmup": (
+                self.runner.recompiles_after_warmup() + spec_recompiles
+            ),
             "tokens_per_s": round(self._tokens_per_s(), 2),
             "ttft": {k: round(v, 6) for k, v in self._ttft_quantiles().items()},
         }
+        if self.spec is not None:
+            prop, acc = self._spec_proposed, self._spec_accepted
+            s["speculative"] = {
+                "k": self.engine_cfg.speculative_k,
+                "k_live": self.scheduler.spec_k_live,
+                "draft": self.engine_cfg.speculative_draft,
+                "proposed_tokens": prop,
+                "accepted_tokens": acc,
+                "rollbacks": self._spec_rollbacks,
+                "acceptance_rate": round(acc / prop, 4) if prop else 0.0,
+            }
         return s
 
     def routing_stats(self) -> Dict[str, Any]:

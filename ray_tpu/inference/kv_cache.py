@@ -263,6 +263,28 @@ class PagedBlockManager:
             self.total_allocs += missing
             return True
 
+    def trim_to(self, request_id: str, num_tokens: int) -> int:
+        """Shrink the request's block list back to exactly cover
+        ``num_tokens`` total positions — the speculative-decode rollback:
+        blocks grown for rejected draft positions are handed back before
+        any other request could observe them. Refcount-aware like
+        :meth:`free` (a trimmed block some other holder still references
+        just drops this request's pin), though in the speculative path
+        trimmed tails are always freshly grown (ref==1, never indexed)
+        so they go straight back to the free list. Returns the number of
+        block references released."""
+        keep = self.blocks_for_tokens(num_tokens)
+        with self._lock:
+            blocks = self._owned.get(request_id)
+            if not blocks or len(blocks) <= keep:
+                return 0
+            released = 0
+            while len(blocks) > keep:
+                self._release_block_locked(blocks.pop())
+                released += 1
+            self.total_frees += released
+            return released
+
     def free(self, request_id: str) -> int:
         """Release every block the request holds (refcount-aware: shared
         blocks survive for their other holders). Returns the number of

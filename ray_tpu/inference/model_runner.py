@@ -28,6 +28,7 @@ from ray_tpu.models.llama import (
     init_paged_kv_cache,
     paged_decode_step,
     paged_prefill_step,
+    paged_verify_step,
     scatter_paged_blocks,
 )
 
@@ -56,6 +57,7 @@ class PagedModelRunner:
         block_size: int,
         prefill_buckets: Sequence[int],
         decode_buckets: Sequence[int],
+        verify_buckets: Sequence[int] = (),
         cache_dtype=None,
     ):
         import jax
@@ -66,6 +68,10 @@ class PagedModelRunner:
         self.num_blocks = num_blocks
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.decode_buckets = tuple(sorted(decode_buckets))
+        #: speculative-verify window buckets (k+1 positions per step);
+        #: empty unless the engine enables speculation, so plain
+        #: deployments keep their exact compile_count
+        self.verify_buckets = tuple(sorted(verify_buckets))
         #: fixed block-table width every request/table row pads to
         self.max_blocks_per_seq = -(-cfg.max_seq_len // block_size)
         if num_blocks - 1 < self.max_blocks_per_seq:
@@ -83,6 +89,13 @@ class PagedModelRunner:
         )
         self._decode_jit = jax.jit(
             partial(paged_decode_step, cfg), donate_argnums=donate
+        )
+        # speculative verification: prefill-shaped, all-position logits.
+        # Always constructed (an uncalled jit holds zero cache entries so
+        # compile accounting is unchanged), only warmed when the engine
+        # passes verify buckets.
+        self._verify_jit = jax.jit(
+            partial(paged_verify_step, cfg), donate_argnums=donate
         )
         # COW block duplication (prefix cache): cache is arg 0 here.
         # partial() gives THIS runner its own jit identity — a bare
@@ -113,6 +126,7 @@ class PagedModelRunner:
         for fn in (
             self._prefill_jit,
             self._decode_jit,
+            self._verify_jit,
             self._copy_jit,
             self._gather_jit,
             self._scatter_jit,
@@ -160,6 +174,21 @@ class PagedModelRunner:
                 np.ones(b, np.int32),
             )
             self._seen_shapes.add(("d", b))
+        # speculative-verify windows (only when the engine opted in via
+        # verify_buckets — plain engines keep their exact compile count).
+        # The batch axis rides the decode buckets: every (B-bucket,
+        # window-bucket) pair a live engine can issue gets compiled here.
+        for c in self.verify_buckets:
+            for b in buckets_decode if buckets_decode is not None else self.decode_buckets:
+                self.cache, _ = self._verify_jit(
+                    self.params,
+                    self.cache,
+                    np.zeros((b, c), np.int32),
+                    np.zeros((b, M), np.int32),
+                    np.zeros(b, np.int32),
+                    np.zeros(b, np.int32),
+                )
+                self._seen_shapes.add(("v", b, c))
         # the COW copy program (all-null pairs write the null block's
         # trash back onto itself)
         pad = np.zeros(_COW_WIDTH, np.int32)
@@ -245,6 +274,38 @@ class PagedModelRunner:
             np.int32(ctx_len), np.int32(true_len),
         )
         return np.asarray(logits)
+
+    def verify_batch(
+        self,
+        windows: Sequence[Sequence[int]],
+        block_rows: Sequence[Sequence[int]],
+        ctx_lens: Sequence[int],
+    ) -> List[np.ndarray]:
+        """Run speculative-verify windows (``[last_committed, d_1..d_k]``
+        each) for a batch of slots in ONE jitted step. Returns one
+        logits array [len(window), vocab] (fp32 numpy) per slot, a row
+        per valid window position. The batch axis pads to a decode
+        bucket; padding slots carry ``true_len=0`` so every position is
+        invalid and the writes land on the null block."""
+        n = len(windows)
+        cbucket = _round_up_bucket(max(len(w) for w in windows), self.verify_buckets)
+        bbucket = _round_up_bucket(n, self.decode_buckets)
+        M = self.max_blocks_per_seq
+        tokens = np.zeros((bbucket, cbucket), np.int32)
+        tables = np.zeros((bbucket, M), np.int32)
+        ctx = np.zeros(bbucket, np.int32)
+        tl = np.zeros(bbucket, np.int32)
+        for i, w in enumerate(windows):
+            tokens[i, : len(w)] = w
+            tables[i] = block_rows[i]
+            ctx[i] = ctx_lens[i]
+            tl[i] = len(w)
+        self._seen_shapes.add(("v", bbucket, cbucket))
+        self.cache, logits = self._verify_jit(
+            self.params, self.cache, tokens, tables, ctx, tl
+        )
+        out = np.asarray(logits)
+        return [out[i, : len(w)] for i, w in enumerate(windows)]
 
     def decode(
         self,

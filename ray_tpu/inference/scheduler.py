@@ -79,6 +79,11 @@ class Request:
     #: lives in the router-tier ledger; resume attempts still book
     #: goodput/fault tokens and file flight-recorder entries.
     record_slo: bool = True
+    #: speculative-decoding draft budget for this request (0 = plain
+    #: decode; the per-request off-switch). The engine stamps it from
+    #: EngineConfig at submit; the scheduler may plan LESS per step
+    #: (``spec_step_k``) under block pressure or adaptive-k shrink.
+    spec_k: int = 0
 
     state: str = QUEUED
     #: prompt positions already written to the KV cache (chunked prefill
@@ -113,6 +118,11 @@ class Request:
     last_emit_at: Optional[float] = None
     #: worst inter-token gap seen (the request's ITL high-water mark)
     max_itl_s: float = 0.0
+    #: drafts the CURRENT step may verify for this slot — set by the
+    #: scheduler every plan (0 = this step decodes plainly): speculation
+    #: is opportunistic, it never preempts and shrinks to zero whenever
+    #: the pool can't cover the extra draft positions
+    spec_step_k: int = 0
 
     @property
     def effective_prompt(self) -> List[int]:
@@ -169,6 +179,13 @@ class ContinuousBatchingScheduler:
         self.max_queue_depth = max_queue_depth
         self.waiting: List[Request] = []
         self.running: List[Request] = []
+        #: engine-side speculative caps, consulted when planning decode
+        #: slots: ``spec_k_live`` is the adaptive-k controller's current
+        #: ceiling (None = uncapped), ``spec_max_context`` the model's
+        #: max_seq_len (draft positions must stay inside the block-table
+        #: row width)
+        self.spec_k_live: Optional[int] = None
+        self.spec_max_context: Optional[int] = None
         self._lock = threading.RLock()
         self.admitting = True
         # observability
@@ -356,6 +373,24 @@ class ContinuousBatchingScheduler:
                 # positions; the token emitted this step grows the table
                 # next step
                 need = req.context_len
+                # speculative slots want k extra positions (the verify
+                # window writes K/V at context_len-1 .. context_len+k-1).
+                # Opportunistic only: spec growth never preempts, and a
+                # dry pool degrades the slot to plain decode this step.
+                k = req.spec_k
+                if k > 0:
+                    if self.spec_k_live is not None:
+                        k = min(k, self.spec_k_live)
+                    k = min(k, req.max_new_tokens - len(req.generated) - 1)
+                    if self.spec_max_context is not None:
+                        k = min(k, self.spec_max_context - need)
+                    k = max(0, k)
+                req.spec_step_k = 0
+                if k > 0 and self.blocks.grow_to(req.request_id, need + k):
+                    req.spec_step_k = k
+                    plan.decodes.append(req)
+                    planned_ids.add(id(req))
+                    continue
                 grown = self.blocks.grow_to(req.request_id, need)
                 while not grown and self._preempt_one(req, planned_ids):
                     grown = self.blocks.grow_to(req.request_id, need)

@@ -39,6 +39,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
+from ray_tpu.core.streaming import TokenChunk
+
 
 class LLMServer:
     """One replica: model params + continuous-batching engine.
@@ -105,14 +107,19 @@ class LLMServer:
 
     def generate(self, request) -> Iterator[int]:
         """Streaming entry (call with ``num_returns="streaming"`` /
-        ``handle.stream(..., _method="generate")``): yields token ids as
-        they decode. Request fields: prompt (required), max_new_tokens,
-        temperature, priority, eos_token, request_id, seed, resume_from.
+        ``handle.stream(..., _method="generate")``): yields
+        :class:`TokenChunk` bursts of token ids as they decode (one per
+        engine wake-up — the serve router flattens them, so
+        ``handle.stream`` consumers still see a per-token stream).
+        Request fields: prompt (required), max_new_tokens,
+        temperature, priority, eos_token, request_id, seed, resume_from,
+        speculative (per-request off-switch for a speculative engine —
+        output bytes are identical either way).
 
         ``resume_from`` (stamped by the serve router for resumable
         streams; absent for direct callers) switches to seq-numbered
         mode: the prompt carries ``resume_from`` already-delivered
-        tokens of an interrupted stream, and items become
+        tokens of an interrupted stream, and chunk elements become
         ``(seq, token)`` pairs so the router can suppress replayed
         duplicates at the failover boundary. ``max_new_tokens`` stays
         the ORIGINAL request's cap — the replica subtracts what was
@@ -170,7 +177,11 @@ class LLMServer:
             # cost into the resume counters from the replica side
             self._reconcile_tier_replay(tier, r["prompt"], resume_from, committed)
         if resume_from is None:
-            yield from self.engine.generate(
+            # bursts ride ONE stream item each (TokenChunk; the router
+            # flattens): a speculative engine commits up to k+1 tokens
+            # per verify step, and per-item stream overhead must be paid
+            # per step, not per token, for that win to reach clients
+            for chunk in self.engine.generate_chunks(
                 r["prompt"],
                 max_new_tokens=r.get("max_new_tokens"),
                 temperature=float(r.get("temperature", 0.0)),
@@ -181,7 +192,9 @@ class LLMServer:
                 tenant_class=tenant_class,
                 ledger_stages=ledger_stages,
                 record_slo=record_slo,
-            )
+                speculative=r.get("speculative"),
+            ):
+                yield TokenChunk(chunk)
             return
         seq = int(resume_from)
         max_new = r.get("max_new_tokens")
@@ -210,7 +223,7 @@ class LLMServer:
             # decoding past the replayed EOS would emit tokens an
             # undisturbed run never produced.
             return
-        for tok in self.engine.generate(
+        for chunk in self.engine.generate_chunks(
             r["prompt"],
             max_new_tokens=remaining,
             temperature=float(r.get("temperature", 0.0)),
@@ -221,13 +234,14 @@ class LLMServer:
             tenant_class=tenant_class,
             ledger_stages=ledger_stages,
             record_slo=record_slo,
+            speculative=r.get("speculative"),
         ):
-            yield (seq, tok)
-            seq += 1
+            yield TokenChunk((seq + i, tok) for i, tok in enumerate(chunk))
+            seq += len(chunk)
 
     def __call__(self, request) -> Dict[str, Any]:
         """Non-streaming: returns the full generation in one reply."""
-        return {"tokens": list(self.generate(request))}
+        return {"tokens": [t for chunk in self.generate(request) for t in chunk]}
 
     # -- disaggregated prefill/decode (inference/kv_transfer.py) ----------
     def prefill_export(self, request) -> Optional[Dict[str, Any]]:

@@ -638,6 +638,83 @@ def paged_prefill_step(
     return cache, logits
 
 
+def paged_verify_step(
+    cfg: LlamaConfig, params, cache, tokens, block_tables, ctx_lens, true_lens
+):
+    """Speculative verification for a BATCH of slots, fixed shapes.
+
+    The batched cross between :func:`paged_prefill_step` (a window of C
+    positions per sequence, ``key_pos <= pos`` causal masking, K/V
+    written as it goes) and :func:`paged_decode_step` (a batch axis over
+    independent slots sharing one jit call). tokens: [B, C] int32
+    (right-padded verify windows ``[last_committed, d_1..d_k]`` per
+    slot), block_tables: [B, M] int32, ctx_lens: [B] int32 tokens
+    already cached per slot, true_lens: [B] int32 valid window lengths
+    (0 for padding slots: every position masks invalid, writes land on
+    the null block). Returns logits for EVERY window position,
+    ``(cache, logits [B, C, vocab])``, so the host accepts or rejects
+    each drafted token independently — B slots verify k+1 positions each
+    in ONE step, where plain decode would spend B*(k+1) batched steps.
+
+    Rejected tail positions leave stale K/V behind; that is safe by
+    construction (decode masks on ``key_pos < ctx_len`` and
+    prefill/verify on ``key_pos <= pos``, so nothing past the committed
+    context is ever read, and re-verification overwrites in place).
+    """
+    if cfg.moe_experts > 0:
+        raise NotImplementedError("paged decode does not support MoE FFNs yet")
+    B, C = tokens.shape
+    M = block_tables.shape[1]
+    bs = cache["k"].shape[2]
+    rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    idx = jnp.arange(C, dtype=jnp.int32)
+    pos = ctx_lens[:, None] + idx[None, :]  # [B, C] global positions
+    valid = idx[None, :] < true_lens[:, None]
+    brange = jnp.arange(B, dtype=jnp.int32)
+    blk = jnp.where(
+        valid,
+        block_tables[brange[:, None], jnp.minimum(pos // bs, M - 1)],
+        0,
+    )
+    off = pos % bs
+    flat_pos = pos.reshape(B * C)
+    cos, sin = _rope_at(cfg, flat_pos)
+    key_pos = jnp.arange(M * bs, dtype=jnp.int32)
+    mask = key_pos[None, None, :] <= pos[:, :, None]  # [B, C, M*bs]
+
+    x = params["embed"][tokens]  # [B, C, D]
+    for layer, p in enumerate(params["layers"]):
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bcd,dhk->bchk", h, p["wq"])
+        k = jnp.einsum("bcd,dhk->bchk", h, p["wk"])
+        v = jnp.einsum("bcd,dhk->bchk", h, p["wv"])
+        hd = q.shape[-1]
+        q = _apply_rope_flat(q.reshape(B * C, cfg.n_heads, hd), cos, sin)
+        k = _apply_rope_flat(k.reshape(B * C, cfg.n_kv_heads, hd), cos, sin)
+        cache = _scatter_kv(
+            cache, layer, blk.reshape(B * C), off.reshape(B * C),
+            k, v.reshape(B * C, cfg.n_kv_heads, hd),
+        )
+        # gather AFTER the scatter so each window attends to itself
+        ks = cache["k"][layer, block_tables].reshape(B, M * bs, cfg.n_kv_heads, -1)
+        vs = cache["v"][layer, block_tables].reshape(B, M * bs, cfg.n_kv_heads, -1)
+        qg = q.reshape(B, C, cfg.n_kv_heads, rep, hd)
+        s = jnp.einsum("bcgrh,bsgh->bcgrs", qg, ks).astype(jnp.float32) * scale
+        s = jnp.where(mask[:, :, None, None, :], s, -1e30)
+        pattn = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bcgrs,bsgh->bcgrh", pattn.astype(vs.dtype), vs)
+        o = o.reshape(B, C, cfg.n_heads, -1)
+        x = x + jnp.einsum("bchk,hkd->bcd", o.astype(x.dtype), p["wo"])
+        hm = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        gate = jnp.einsum("bcd,dm->bcm", hm, p["w_gate"])
+        up = jnp.einsum("bcd,dm->bcm", hm, p["w_up"])
+        x = x + jnp.einsum("bcm,md->bcd", jax.nn.silu(gate) * up, p["w_down"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return cache, jnp.einsum("bcd,dv->bcv", x, params["lm_head"]).astype(jnp.float32)
+
+
 def paged_decode_step(
     cfg: LlamaConfig, params, cache, tokens, positions, block_tables, ctx_lens
 ):

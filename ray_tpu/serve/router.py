@@ -52,7 +52,7 @@ from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.deadline import Deadline, effective_timeout
 from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
 from ray_tpu.core.rpc import ConnectionLost
-from ray_tpu.core.streaming import SeqGate
+from ray_tpu.core.streaming import SeqGate, TokenChunk
 from ray_tpu.observability import tracing as _tracing
 
 _STATS_TTL_S = 0.25
@@ -836,9 +836,21 @@ class Router:
                     continue
                 def _rest(first=first, gen=gen):
                     try:
-                        yield first
+                        # TokenChunk = a producer-coalesced burst (one
+                        # ref per engine wake-up); flatten so consumers
+                        # see the per-token stream. Bare lists pass
+                        # through — a generic stream may yield them as
+                        # VALUES.
+                        if isinstance(first, TokenChunk):
+                            yield from first
+                        else:
+                            yield first
                         for ref in gen:
-                            yield ray_tpu.get(ref, timeout=item_timeout)
+                            item = ray_tpu.get(ref, timeout=item_timeout)
+                            if isinstance(item, TokenChunk):
+                                yield from item
+                            else:
+                                yield item
                     finally:
                         # consumer done OR walked away (close()/GC — an
                         # HTTP client disconnect closes this generator):
@@ -1071,20 +1083,32 @@ class Router:
                                 else item_timeout,
                             )
                             first = False
-                            try:
-                                seq, token = item
-                            except (TypeError, ValueError):
-                                # a redeploy swapped in a callable that no
-                                # longer speaks the seq protocol while this
-                                # stream (or a stale cache window) was live
-                                raise RuntimeError(
-                                    f"resumable stream {self._deployment}."
-                                    f"{method} yielded {type(item).__name__}, "
-                                    "not a (seq, item) pair — was the "
-                                    "deployment redeployed without "
-                                    "resumable_streams?"
-                                ) from None
-                            if gate.admit(seq):
+                            # one stream item = one producer burst
+                            # (TokenChunk of (seq, token) pairs) or a
+                            # single bare pair from an older callable
+                            pairs = (
+                                item
+                                if isinstance(item, TokenChunk)
+                                else [item]
+                            )
+                            for pair in pairs:
+                                try:
+                                    seq, token = pair
+                                except (TypeError, ValueError):
+                                    # a redeploy swapped in a callable
+                                    # that no longer speaks the seq
+                                    # protocol while this stream (or a
+                                    # stale cache window) was live
+                                    raise RuntimeError(
+                                        f"resumable stream "
+                                        f"{self._deployment}.{method} "
+                                        f"yielded {type(pair).__name__}, "
+                                        "not a (seq, item) pair — was "
+                                        "the deployment redeployed "
+                                        "without resumable_streams?"
+                                    ) from None
+                                if not gate.admit(seq):
+                                    continue
                                 now = time.monotonic()
                                 if first_at is None:
                                     first_at = now

@@ -3,6 +3,12 @@ scale-down, and atomic TPU-slice launches.
 
 Reference test model: ``python/ray/tests/test_autoscaler_fake_multinode.py``
 on ``FakeMultiNodeProvider`` (``fake_multi_node/node_provider.py:236``).
+
+One MODULE-scoped cluster serves every test (boot/teardown was ~3x the
+module's actual test time); each test builds its own ``StandardAutoscaler``
+against the shared provider, and an autouse fixture reaps any autoscaled
+nodes a test leaves behind and waits for the controller to notice — the
+exact-node-count assertions below depend on starting from a bare head.
 """
 
 import time
@@ -19,7 +25,7 @@ from ray_tpu.autoscaler import (
 from ray_tpu.cluster_utils import Cluster
 
 
-@pytest.fixture()
+@pytest.fixture(scope="module")
 def small_cluster():
     from ray_tpu.core.config import GLOBAL_CONFIG
 
@@ -40,6 +46,24 @@ def small_cluster():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _reap_leftover_nodes(small_cluster):
+    """Module-scoped-cluster hygiene: terminate every autoscaled node a
+    test left running (e.g. the demand test ends inside its 30s idle
+    window) and wait until the controller agrees only the head is alive
+    — otherwise a stale 4-CPU node record absorbs the next test's
+    demand probe and its exact provider-node-count assertions drift."""
+    _cluster, provider = small_cluster
+    yield
+    for rec in provider.non_terminated_nodes():
+        provider.terminate_node(rec["id"])
+    _wait(
+        lambda: sum(1 for n in ray_tpu.nodes() if n["Alive"]) == 1,
+        timeout=60,
+        msg="leftover autoscaled nodes should leave the cluster",
+    )
 
 
 def _wait(pred, timeout=60, msg=""):

@@ -84,6 +84,12 @@ def _digests(tokens, bs=8):
     return out
 
 
+def _collect(gen):
+    """Flatten LLMServer.generate's TokenChunk bursts — the serve
+    router does the same before clients see individual items."""
+    return [t for chunk in gen for t in chunk]
+
+
 # ---------------------------------------------------------------------------
 # unit: KvTierFaultPlan
 
@@ -276,6 +282,65 @@ def test_local_tier_roundtrip_delete_and_cap():
         GLOBAL_CONFIG.kv_tier_max_entries = old_cap
 
 
+def test_daemon_tier_popularity_eviction_hot_prefix_outlives_cold():
+    """PR 19 satellite: the daemon registry's cap eviction is keyed on
+    (hit count, recency), not insertion age — a hot shared prefix that
+    readers keep faulting in outlives colder NEWER entries. Drives the
+    real NodeDaemon registry methods on a stub (no cluster, no sockets:
+    the registry touches only its own dicts + store.delete)."""
+    import asyncio
+    from collections import OrderedDict
+
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.core.node_daemon import NodeDaemon
+
+    class _Stub:
+        class store:  # noqa: N801 — _kv_tier_drop_locked calls .delete
+            @staticmethod
+            def delete(oid):
+                pass
+
+    stub = _Stub()
+    stub._kv_tier = OrderedDict()
+    stub._last_kv_tier_sweep = 0.0
+    stub._kv_tier_sweep = NodeDaemon._kv_tier_sweep.__get__(stub)
+    stub._kv_tier_drop_locked = NodeDaemon._kv_tier_drop_locked.__get__(stub)
+
+    def put(d):
+        stub._last_kv_tier_sweep = -1e9  # defeat the 1s sweep throttle
+        assert asyncio.run(
+            NodeDaemon.d_kv_tier_put(stub, {"digest": d, "desc": {"d": d}}, None)
+        )
+
+    def get(d):
+        return asyncio.run(NodeDaemon.d_kv_tier_get(stub, {"digest": d}, None))
+
+    old_cap = GLOBAL_CONFIG.kv_tier_max_entries
+    GLOBAL_CONFIG.kv_tier_max_entries = 3
+    try:
+        for d in ("hot", "cold1", "cold2"):
+            put(d)
+        for _ in range(4):  # the shared prefix keeps getting faulted in
+            assert get("hot") == {"d": "hot"}
+        # two colder NEWER entries arrive over cap: the zero-hit ones go
+        # (oldest-recency first), the hot OLDEST entry survives both
+        put("new1")
+        assert set(stub._kv_tier) == {"hot", "cold2", "new1"}
+        put("new2")
+        assert set(stub._kv_tier) == {"hot", "new1", "new2"}
+        # a re-put of a live digest counts as a use too
+        put("new1")
+        assert stub._kv_tier["new1"]["hits"] == 1
+        # TTL still dominates popularity: an expired hot entry drops
+        stub._kv_tier["hot"]["expiry"] = -1.0
+        stub._last_kv_tier_sweep = -1e9
+        NodeDaemon._kv_tier_sweep(stub)
+        assert "hot" not in stub._kv_tier
+        assert get("hot") is None
+    finally:
+        GLOBAL_CONFIG.kv_tier_max_entries = old_cap
+
+
 def test_tier_fetch_chaos_modes_hit_the_integrity_gate():
     import numpy as np
 
@@ -442,7 +507,7 @@ def test_tier_fault_in_across_servers_byte_exact(cfg, params):
     hits_before = KV_TIER_HITS._values.get((), 0.0)
     b = LLMServer(cfg, _ec(), params=params, export_metrics=False)
     try:
-        out_b = list(b.generate({
+        out_b = _collect(b.generate({
             "prompt": prompt, "max_new_tokens": 6,
             "temperature": 0.7, "seed": 3, "kv_tier": dict(spec),
         }))
@@ -458,7 +523,7 @@ def test_tier_fault_in_across_servers_byte_exact(cfg, params):
     c = LLMServer(cfg, _ec(), params=params, export_metrics=False)
     try:
         c.testing_arm_kv_tier_chaos("missing_block:1.0:0:99", 13)
-        out_c = list(c.generate({
+        out_c = _collect(c.generate({
             "prompt": prompt, "max_new_tokens": 6,
             "temperature": 0.7, "seed": 3, "kv_tier": dict(spec),
         }))
@@ -477,7 +542,7 @@ def test_tier_fault_in_across_servers_byte_exact(cfg, params):
     d = LLMServer(cfg, _ec(), params=params, export_metrics=False)
     try:
         d.testing_arm_kv_tier_chaos("corrupt_block:1.0:0:99", 17)
-        out_d = list(d.generate({
+        out_d = _collect(d.generate({
             "prompt": prompt, "max_new_tokens": 6,
             "temperature": 0.7, "seed": 3, "kv_tier": dict(spec),
         }))
@@ -562,7 +627,7 @@ def test_drain_migration_flushes_full_kv_and_survivor_resumes(cfg, params):
             "blocks": [[dg.hex(), adverts[dg.hex()]] for dg in chain],
             "tokens": len(chain) * 8,
         }
-        out = list(b.generate({
+        out = _collect(b.generate({
             "prompt": extended, "max_new_tokens": max_new,
             "temperature": 0.7, "seed": 11, "resume_from": d,
             "kv_tier": spec, "request_id": "mig-resume",
@@ -656,7 +721,7 @@ def test_tier_namespace_scopes_models(cfg, params):
     srv = LLMServer(cfg, _ec(), params=params, export_metrics=False)
     try:
         fb_before = KV_TIER_FALLBACKS._values.get(("namespace",), 0.0)
-        out = list(srv.generate({
+        out = _collect(srv.generate({
             "prompt": SHARED + [77], "max_new_tokens": 4,
             "temperature": 0.7, "seed": 3,
             "kv_tier": {"blocks": [[d1.hex(), db]], "tokens": 8},
@@ -715,7 +780,7 @@ def test_covered_but_failed_fault_in_books_replay_shortfall(cfg, params):
     try:
         b.testing_arm_kv_tier_chaos("missing_block:1.0:0:99", 13)
         before = STREAM_RESUME_REPLAY_TOKENS._values.get((), 0.0)
-        out = list(b.generate({
+        out = _collect(b.generate({
             "prompt": extended, "max_new_tokens": max_new,
             "temperature": 0.7, "seed": 11, "resume_from": seq,
             "kv_tier": dict(spec), "request_id": "rs-shortfall",

@@ -1,0 +1,331 @@
+"""Speculative decoding (PR 19): propose / one-step verify / byte-exact
+accept-rollback on the paged KV cache.
+
+Everything here is cluster-free and lean per the ROADMAP caution: tiny
+model, ``warmup=False`` everywhere except the single recompile-gate test
+(which needs a real warmup to assert zero post-warmup compiles across
+the target runner, the verify step AND the draft runner).
+
+The load-bearing invariants:
+
+* output streams are BYTE-IDENTICAL to a plain engine for temp=0 and
+  seeded temp>0, at several k including a k whose verify window
+  straddles a block boundary (rollback then exercises block rewind);
+* rejected/unverified positions never reach the radix prefix index and
+  never publish to the KV tier — adverts cap at the verified cursor;
+* block-manager books balance exactly after rollback-heavy runs;
+* adaptive k shrinks under low acceptance and recovers, without ever
+  recompiling (the verify bucket stays sized for speculative_k+1).
+"""
+
+import pytest
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+
+from ray_tpu.inference.engine import EngineConfig, InferenceEngine  # noqa: E402
+from ray_tpu.inference.kv_cache import (  # noqa: E402
+    PagedBlockManager,
+    _chain_digest,
+)
+from ray_tpu.inference.speculative import NgramProposer  # noqa: E402
+from ray_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+
+#: repetitive prompt: the ngram proposer finds matches, so speculative
+#: steps exercise BOTH accept and rollback against the random target
+PROMPT = [1, 2, 3, 4, 5, 6, 7, 1, 2, 3, 4, 5]
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def draft_params(cfg):
+    # different init -> the draft disagrees with the target often enough
+    # to exercise rollback, agrees rarely enough to exercise accept
+    return init_params(cfg, jax.random.PRNGKey(7))
+
+
+def _ec(**overrides):
+    kw = dict(
+        num_blocks=64, block_size=8, prefill_buckets=(8, 16),
+        decode_buckets=(1, 2, 4), max_decode_batch=4,
+        max_new_tokens_default=8, warmup=False,
+    )
+    kw.update(overrides)
+    return EngineConfig(**kw)
+
+
+def _run(cfg, params, ec, *, temp=0.0, seed=None, n=20, **gen_kw):
+    eng = InferenceEngine(cfg, params, ec).start()
+    try:
+        out = list(
+            eng.generate(
+                PROMPT, max_new_tokens=n, temperature=temp, seed=seed,
+                **gen_kw,
+            )
+        )
+        return out, eng.stats()
+    finally:
+        eng.stop()
+
+
+def _digests(tokens, bs=8):
+    """Full-block chain digests of ``tokens`` (tier + prefix key space)."""
+    out, prev = [], b""
+    for end in range(bs, len(tokens) + 1, bs):
+        prev = _chain_digest(prev, tokens[end - bs : end])
+        out.append(prev)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# units: proposer + rollback bookkeeping (no engine, no jit)
+
+
+def test_ngram_proposer_prompt_lookup():
+    p = NgramProposer(max_ngram=3, min_ngram=1)
+    # trailing [1,2,3] recurs at the start; the continuation follows it
+    assert p.propose([1, 2, 3, 9, 8, 1, 2, 3], 3) == [9, 8, 1]
+    # k truncates at the end of the context
+    assert p.propose([1, 2, 3, 9, 8, 1, 2, 3], 99) == [9, 8, 1, 2, 3]
+    # longest n-gram wins over a shorter, more recent match
+    assert p.propose([5, 6, 7, 4, 7, 5, 6, 7], 1) == [4]
+    # most recent PRIOR occurrence wins within one n-gram length
+    assert p.propose([2, 8, 2, 9, 2], 1, request_id="r") == [9]
+    # nothing repeats -> no draft (engine degrades to plain decode)
+    assert p.propose([1, 2, 3, 4, 5], 4) == []
+    assert p.propose([1, 2, 3], 0) == []
+    with pytest.raises(ValueError):
+        NgramProposer(max_ngram=1, min_ngram=2)
+
+
+def test_trim_to_rewinds_block_books_exactly():
+    bm = PagedBlockManager(16, 8)
+    base = bm.stats()["free_blocks"]
+    assert bm.grow_to("r", 12)  # 2 blocks for the committed context
+    assert bm.grow_to("r", 12 + 7)  # +1 block for a k=7 verify window
+    allocs = bm.total_allocs
+    assert bm.stats()["free_blocks"] == base - 3
+    # full rollback of the speculative tail: back to 12 tokens
+    assert bm.trim_to("r", 12) == 1
+    assert bm.stats()["free_blocks"] == base - 2
+    # idempotent / no-op when already at (or below) the cursor
+    assert bm.trim_to("r", 12) == 0
+    assert bm.trim_to("missing", 4) == 0
+    bm.free("r")
+    assert bm.stats()["free_blocks"] == base
+    assert bm.total_allocs == allocs and bm.total_frees == allocs
+
+
+# ---------------------------------------------------------------------------
+# byte-exactness: speculative output == plain output, always
+
+
+@pytest.mark.parametrize("temp,seed", [(0.0, None), (0.8, 123)])
+def test_cross_engine_byte_exact_ngram(cfg, params, temp, seed):
+    ref, _ = _run(cfg, params, _ec(), temp=temp, seed=seed)
+    # k=7 -> an 8-wide verify window on block_size 8: windows straddle
+    # block boundaries, so rollback exercises tail-block rewind
+    for k in (2, 7):
+        out, st = _run(
+            cfg, params, _ec(speculative_k=k), temp=temp, seed=seed
+        )
+        assert out == ref, (k, temp)
+        assert st["speculative"]["proposed_tokens"] > 0
+
+
+def test_cross_engine_byte_exact_draft_model(cfg, params, draft_params):
+    # a DISAGREEING draft model: heavy rollback traffic, same bytes.
+    # temp>0 makes the target sample while the draft argmaxes — the
+    # worst case for acceptance, the best case for rollback coverage.
+    for temp, seed in ((0.0, None), (0.8, 123)):
+        ref, _ = _run(cfg, params, _ec(), temp=temp, seed=seed)
+        out, st = _run(
+            cfg,
+            params,
+            _ec(
+                speculative_k=3,
+                speculative_draft="model",
+                draft_config=cfg,
+                draft_params=draft_params,
+                draft_num_blocks=32,
+            ),
+            temp=temp,
+            seed=seed,
+        )
+        assert out == ref, temp
+        sp = st["speculative"]
+        assert sp["draft"] == "model" and sp["proposed_tokens"] > 0
+
+
+def test_draft_equals_target_accepts_everything(cfg, params):
+    # draft == target -> greedy drafts always match the greedy sample
+    out, st = _run(
+        cfg,
+        params,
+        _ec(
+            speculative_k=4,
+            speculative_draft="model",
+            draft_config=cfg,
+            draft_params=params,
+            speculative_adaptive=False,
+        ),
+    )
+    ref, _ = _run(cfg, params, _ec())
+    sp = st["speculative"]
+    assert out == ref
+    assert sp["rollbacks"] == 0
+    assert sp["accepted_tokens"] == sp["proposed_tokens"] > 0
+
+
+def test_per_request_off_switch(cfg, params):
+    ref, _ = _run(cfg, params, _ec())
+    out, st = _run(
+        cfg, params, _ec(speculative_k=4), speculative=False
+    )
+    assert out == ref
+    assert st["speculative"]["proposed_tokens"] == 0
+
+
+# ---------------------------------------------------------------------------
+# isolation: rejected positions never escape the verified cursor
+
+
+def test_rollback_never_pollutes_prefix_index_or_tier(cfg, params):
+    from ray_tpu.inference import kv_transfer
+
+    ec = _ec(
+        speculative_k=4,
+        kv_transfer_enabled=True,
+        kv_tier_enabled=True,
+        speculative_adaptive=False,
+    )
+    eng = InferenceEngine(cfg, params, ec).start()
+    try:
+        # an always-wrong proposer: every verify step writes a rejected
+        # tail into the paged cache, every step rolls back
+        class _Garbage:
+            def propose(self, ctx, k, request_id=""):
+                return [255] * k
+
+            def release(self, request_id):
+                pass
+
+            def compile_count(self):
+                return 0
+
+            def recompiles_after_warmup(self):
+                return 0
+
+        eng.spec = _Garbage()
+        out = list(eng.generate(PROMPT, max_new_tokens=20, temperature=0.0))
+        st = eng.stats()
+        assert st["speculative"]["rollbacks"] > 0
+        assert eng.flush_tier_writebacks()
+        # every tier advert AND every indexed prefix digest must key
+        # verified tokens only — the chain digests of prompt+generated
+        # (rejected drafts were emitted by neither)
+        verified = set(d.hex() for d in _digests(PROMPT + out))
+        assert set(eng._tier_adverts) <= verified
+        assert st["speculative"]["proposed_tokens"] > 0
+        with eng.blocks._lock:
+            indexed = set(d.hex() for d in eng.blocks._index)
+        assert indexed <= verified
+        # block books balance exactly after a rollback-heavy run: no
+        # holders, nothing pinned — the only surviving blocks are the
+        # verified full blocks parked in the prefix LRU
+        bs = eng.blocks.stats()
+        assert bs["holders"] == 0
+        assert bs["used_blocks"] == 0
+        n_full_verified = (len(PROMPT) + len(out) - 1) // bs["block_size"]
+        assert bs["prefix_cached_blocks"] == n_full_verified
+    finally:
+        eng.stop()
+    with kv_transfer._LOCAL_TIER_LOCK:
+        kv_transfer._LOCAL_TIER.clear()
+
+
+# ---------------------------------------------------------------------------
+# adaptive k + compile gate
+
+
+def test_adaptive_k_shrinks_and_recovers(cfg, params):
+    eng = InferenceEngine(cfg, params, _ec(speculative_k=4)).start()
+    try:
+        assert eng.scheduler.spec_k_live == 4
+        # low-acceptance window -> controller sheds one draft token
+        eng._spec_proposed, eng._spec_accepted = 16, 1
+        eng._next_gauge_refresh = 0.0
+        eng._update_gauges(0)
+        assert eng.scheduler.spec_k_live == 3
+        assert eng.stats()["speculative"]["k_live"] == 3
+        # hot window -> grows back toward the configured ceiling
+        eng._spec_proposed, eng._spec_accepted = 32, 17
+        eng._next_gauge_refresh = 0.0
+        eng._update_gauges(0)
+        assert eng.scheduler.spec_k_live == 4
+        # tiny windows (< 8 proposals) never steer
+        eng._spec_proposed, eng._spec_accepted = 33, 17
+        eng._next_gauge_refresh = 0.0
+        eng._update_gauges(0)
+        assert eng.scheduler.spec_k_live == 4
+    finally:
+        eng.stop()
+
+
+def test_zero_recompiles_after_warmup_with_draft(cfg, params):
+    # the ONE warmed engine in this module: minimal buckets, and the
+    # warmup set must cover target prefill+decode, the verify bucket
+    # (speculative_k+1) AND the draft runner's own buckets
+    ec = _ec(
+        prefill_buckets=(16,),
+        decode_buckets=(1,),
+        max_decode_batch=1,
+        warmup=True,
+        speculative_k=2,
+        speculative_draft="model",
+        draft_config=cfg,
+        draft_params=params,
+        draft_num_blocks=32,
+        draft_prefill_buckets=(16,),
+        speculative_adaptive=False,
+    )
+    eng = InferenceEngine(cfg, params, ec).start()
+    try:
+        warm = eng.stats()["compile_count"]
+        out = list(eng.generate(PROMPT, max_new_tokens=10, temperature=0.0))
+        st = eng.stats()
+        assert len(out) == 10
+        assert st["speculative"]["accepted_tokens"] > 0
+        assert st["compile_count"] == warm
+        assert st["recompiles_after_warmup"] == 0
+    finally:
+        eng.stop()
+
+
+def test_plain_engine_keeps_exact_compile_count(cfg, params):
+    # the verify jit is constructed unconditionally but never traced on
+    # a plain engine — compile books must not move (test_inference pins
+    # the same invariant with its own bucket set; this pins it next to
+    # the code that could break it)
+    ec = _ec(
+        prefill_buckets=(8, 16), decode_buckets=(1, 2),
+        max_decode_batch=2, warmup=True,
+    )
+    eng = InferenceEngine(cfg, params, _ec()).start()
+    eng.stop()
+    eng = InferenceEngine(cfg, params, ec).start()
+    try:
+        assert eng.runner.compile_count() == 2 + 2 + 1
+        assert eng.stats()["compile_count"] == 2 + 2 + 1
+    finally:
+        eng.stop()

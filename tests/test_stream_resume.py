@@ -170,6 +170,45 @@ def test_cross_engine_determinism_and_midstream_resume(cfg, params):
         e2.stop()
 
 
+def test_spec_midstream_resume_byte_exact_on_any_survivor(cfg, params):
+    """PR 19 regression: a stream generated WITH speculation that dies
+    mid-stream resumes byte-exact on a survivor whether the survivor
+    speculates or not — acceptance is exact-match against the same
+    (seed, absolute-position) sampler, so the delivered prefix replayed
+    as prompt continues identically in all four (dead, survivor)
+    speculation combinations."""
+    prompt = [3, 7, 11, 5, 3, 7, 11, 5]  # repetitive: drafts actually fire
+    e_spec = _engine(cfg, params, speculative_k=3, warmup=False)
+    e_plain = _engine(cfg, params, warmup=False)
+    try:
+        ref = list(
+            e_plain.generate(prompt, max_new_tokens=12, temperature=0.7, seed=42)
+        )
+        a = list(
+            e_spec.generate(prompt, max_new_tokens=12, temperature=0.7, seed=42)
+        )
+        assert a == ref, "speculative stream diverged from plain"
+        # the replica died after delivering a[:k]; the router replays the
+        # prefix as prompt on a survivor with speculation on OR off
+        for k in (1, 5, 11):
+            for survivor in (e_plain, e_spec):
+                tail = list(
+                    survivor.generate(
+                        prompt + a[:k], max_new_tokens=12 - k,
+                        temperature=0.7, seed=42,
+                    )
+                )
+                assert tail == a[k:], (k, survivor is e_spec)
+        # and the mirror: a plain-engine stream resumed on a SPECULATIVE
+        # survivor (greedy this time) — same bytes
+        g = list(e_plain.generate(prompt, max_new_tokens=12))
+        assert list(e_spec.generate(prompt + g[:4], max_new_tokens=8)) == g[4:]
+        assert e_spec.stats()["speculative"]["proposed_tokens"] > 0
+    finally:
+        e_spec.stop()
+        e_plain.stop()
+
+
 def test_resumed_request_keeps_seq_under_preemption(cfg, params):
     """Resume-under-preemption: a RESUMED request (prompt = original +
     delivered prefix) that is evicted for blocks and readmitted still
@@ -228,10 +267,12 @@ def test_resume_after_delivered_eos_emits_nothing(cfg, params):
         }))
         assert out == [], "resume decoded past a delivered EOS"
         # same resume WITHOUT eos keeps generating, seq-numbered from 3
-        out2 = list(server.generate({
+        # the replica yields TokenChunk bursts of (seq, tok) pairs —
+        # flatten (the serve router does the same before clients see it)
+        out2 = [p for chunk in server.generate({
             "prompt": [3, 1, 4, 99], "max_new_tokens": 8,
             "resume_from": 3, "request_id": "no-eos",
-        }))
+        }) for p in chunk]
         assert len(out2) == 5 and out2[0][0] == 3 and out2[-1][0] == 7
         # an eos INSIDE the original prompt (resume_from=0: nothing was
         # delivered yet) must not close the stream
@@ -278,9 +319,17 @@ def test_e2e_hot_replica_killed_mid_decode_byte_exact(
     from ray_tpu.observability.rpc_metrics import STREAM_RESUME_REPLAY_TOKENS
 
     SPEC, SEED = CHAOS_SPEC, CHAOS_SEED
+    # speculative_k=2 (PR 19): the kill now lands mid-SPECULATIVE-decode
+    # — rollback state, partially-accepted windows and all — and the
+    # resumed streams must still be byte-exact. The reference engine
+    # shares the config, but exact-match acceptance makes its output
+    # identical to a plain engine's anyway; chaos consults tick once per
+    # step whether the slot speculated or not, so the seeded kill
+    # schedule is unchanged.
     ec = EngineConfig(
         num_blocks=64, block_size=8, prefill_buckets=(8, 32),
         decode_buckets=(1, 8), max_decode_batch=8, max_new_tokens_default=8,
+        speculative_k=2,
     )
     shared = [11, 3, 7, 5, 2, 9, 8, 6] * 3  # 24 tokens = 3 full blocks
     n, max_new = 8, 12
