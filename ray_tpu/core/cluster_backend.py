@@ -163,14 +163,21 @@ def spawn_node(
 
 
 def spawn_controller(
-    session_dir: str, port: int = 0
+    session_dir: str, port: int = 0, standby: bool = False
 ) -> subprocess.Popen:
     """Spawn a STANDALONE controller process (``controller_main.py``) —
     the failover topology where the control plane can be killed and
-    restarted from its snapshot independently of every node daemon.
-    Restarting with the same ``session_dir`` restores state AND the old
-    listening port, so clients reconnect with no rediscovery. The
-    returned proc carries ``controller_port``."""
+    restarted from its snapshot + WAL independently of every node
+    daemon. Restarting with the same ``session_dir`` restores state AND
+    the old listening port, so clients reconnect with no rediscovery.
+    The returned proc carries ``controller_port``.
+
+    ``standby=True`` starts a HOT STANDBY follower instead: it tails the
+    session WAL and the active's lease file, and promotes itself (WAL
+    replay to the tip, epoch bump, same-port rebind) the moment the
+    lease goes stale or is released. Its ``controller_port`` is the
+    port the ACTIVE held at spawn time — the address the promoted
+    standby will rebind."""
     from ray_tpu.core.config import serialize_config
 
     os.makedirs(session_dir, exist_ok=True)
@@ -179,10 +186,14 @@ def spawn_controller(
         "--session-dir", session_dir, "--port", str(port),
         "--system-config", serialize_config(),
     ]
+    log_name = "controller-standby.log" if standby else "controller.log"
+    if standby:
+        cmd.append("--standby")
     proc, info = _spawn_and_handshake(
-        cmd, os.path.join(session_dir, "controller.log"), "controller"
+        cmd, os.path.join(session_dir, log_name), "controller"
     )
     proc.controller_port = info["controller_port"]  # type: ignore[attr-defined]
+    proc.standby = bool(info.get("standby", False))  # type: ignore[attr-defined]
     return proc
 
 

@@ -159,6 +159,22 @@ class GlobalConfig:
     #: grace window for daemons to re-register/sync after a controller
     #: restart before unadopted restored state is rescheduled
     controller_restore_grace_s: float = 10.0
+    #: controller snapshot (WAL compaction) period; mutations acked
+    #: between ticks are covered by the WAL, so raising this trades
+    #: replay length for snapshot churn, never durability
+    controller_persist_interval_s: float = 1.0
+    #: controller WAL fsync policy: fsync every N appended records
+    #: (1 = every record, the zero-loss default); 0 = flush to the OS
+    #: only (process-crash safe, not host-crash safe). See core/wal.py.
+    controller_wal_fsync: int = 1
+    #: active controller lease heartbeat period (core/wal.py lease file;
+    #: a hot standby polls the same file at this period)
+    controller_lease_interval_s: float = 0.5
+    #: lease staleness bound: a standby takes over when the lease stamp
+    #: is older than this; the ACTIVE self-fences acks at ~75% of it
+    #: (stops acking mutations strictly before a standby can assume the
+    #: lease is dead — the classic lease safety margin)
+    controller_lease_timeout_s: float = 2.0
 
     # --- SLO ledger (observability/slo.py) ---
     #: flight-recorder slowest-K slots per process (fixed-size heap of
@@ -399,6 +415,16 @@ class GlobalConfig:
     #: RNG seed for the KV-tier fault plan; 0 = generate one (logged at
     #: activation for replay)
     testing_kv_tier_chaos_seed: int = 0
+    #: seeded CONTROLLER fault plan consulted by the control plane's
+    #: WAL-append ("mutation"), snapshot ("snapshot") and lease-heartbeat
+    #: ("lease") paths: "mode:prob[:param][:max],..." with mode in
+    #: {kill_mid_mutation, kill_mid_snapshot, partition,
+    #: zombie_resurrect} — see util/chaos.py::ControllerFaultPlan (same
+    #: determinism contract as ReplicaFaultPlan). Empty = no injection.
+    testing_controller_chaos: str = ""
+    #: RNG seed for the controller fault plan; 0 = generate one (logged
+    #: at activation for replay)
+    testing_controller_chaos_seed: int = 0
     #: MASTER chaos seed: when non-zero, every fault plan whose own seed
     #: knob is 0 derives its seed deterministically from this one value
     #: (util/chaos.py::derive_plan_seed — keyed blake2b of the plan

@@ -20,23 +20,52 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import pickle
+import signal
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu.core import rpc as _rpc
+from ray_tpu.core import wal as _walmod
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.ids import ActorID, NodeID, PlacementGroupID
 from ray_tpu.core.refs import Address
-from ray_tpu.core.rpc import RpcClient, RpcServer, ServerConnection
+from ray_tpu.core.rpc import (
+    RpcClient,
+    RpcServer,
+    ServerConnection,
+    StaleControllerError,
+)
 from ray_tpu.core.scheduling_policies import (
     BundleReservation,
     pick_node_hybrid,
     place_bundles,
 )
 from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.util.chaos import ControllerFaultPlan, SeededPlanCache
 
 logger = logging.getLogger(__name__)
+
+#: process-wide seeded controller fault plan (util/chaos.py grammar;
+#: armed via RAY_TPU_testing_controller_chaos, seed logged at activation)
+_PLAN_CACHE = SeededPlanCache(
+    ControllerFaultPlan,
+    "controller",
+    "testing_controller_chaos",
+    "testing_controller_chaos_seed",
+    logger,
+)
+
+
+def active_controller_fault_plan() -> Optional[ControllerFaultPlan]:
+    return _PLAN_CACHE.active()
+
+
+#: sentinel for "this WAL record has no journaled reply"
+_NO_REPLY = object()
 
 ACTOR_PUSH_CHANNEL = 1
 NODE_PUSH_CHANNEL = 2
@@ -91,10 +120,50 @@ class PgInfo:
 
 class Controller:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 persist_path: Optional[str] = None):
+                 persist_path: Optional[str] = None,
+                 takeover: bool = False):
         #: optional snapshot file: tables survive a controller restart
         #: (reference: GCS rebuilds from Redis, ``gcs_init_data.cc``)
         self.persist_path = persist_path
+        #: True when this incarnation is a promoted hot standby
+        #: (controller_main --standby) — surfaced in cluster_status
+        self.takeover = takeover
+        # Durability/failover sidecar files share the snapshot's
+        # directory (the session dir): the write-ahead log, the lease
+        # heartbeat file a standby watches, and the durable incarnation
+        # epoch. All gated on persist_path — an ephemeral (test-local)
+        # controller has no durability contract.
+        base = os.path.dirname(os.path.abspath(persist_path)) if persist_path else None
+        self._wal_path = os.path.join(base, "controller.wal") if base else None
+        self._lease_path = os.path.join(base, "controller.lease") if base else None
+        self._epoch_path = os.path.join(base, "controller.epoch") if base else None
+        #: incarnation epoch (fencing token): bumped durably on EVERY
+        #: start, so a restart/takeover always outranks its predecessor
+        self.epoch = 0
+        self._wal: Optional[_walmod.WalWriter] = None
+        self._lease_task: Optional[asyncio.Task] = None
+        #: wall-clock stamp of the last successfully written lease
+        #: heartbeat; mutations self-fence when it goes stale (see
+        #: _check_fenced — the lease safety margin)
+        self._last_lease_ok = time.time()
+        #: deposed: a higher epoch exists. Mutations are refused and
+        #: stop() must NOT touch the WAL/snapshot (they belong to the
+        #: new incumbent now).
+        self._fenced = False
+        #: chaos (partition/zombie_resurrect): heartbeats suppressed
+        #: until this wall-clock stamp, then _silent_mode's resume logic
+        self._silent_until = 0.0
+        self._silent_mode: Optional[str] = None
+        #: daemon addresses learned from registrations AND replayed from
+        #: the WAL: a takeover announces its new epoch to these before
+        #: it can even bind the old port (fences any zombie writes)
+        self._known_daemons: Dict[bytes, Tuple[str, int]] = {}
+        #: structured recovery report (snapshot + WAL replay summary),
+        #: exposed via cluster_status()["controller"]
+        self.recovery_report: Dict[str, Any] = {}
+        #: optional hook invoked once when this controller is deposed
+        #: (controller_main sets it to trip the process stop event)
+        self.on_deposed = None
         self.server = RpcServer(host, port)
         self.nodes: Dict[bytes, NodeInfo] = {}
         self.node_clients: Dict[bytes, RpcClient] = {}
@@ -139,6 +208,35 @@ class Controller:
 
     async def start(self) -> int:
         restored_port = self._load_snapshot()
+        if not restored_port and self._lease_path:
+            # no snapshot tick ever ran (crash inside the first period):
+            # the lease heartbeat file still records the bound port, so
+            # a restart can rebind it and keep every client's address
+            lease = _walmod.read_lease(self._lease_path)
+            if lease is not None:
+                restored_port = lease.get("port") or None
+        wal_records = self._open_and_replay_wal()
+        self._bump_epoch()
+        if self.recovery_report:
+            self.recovery_report["wal_records"] = wal_records
+            self.recovery_report["epoch"] = self.epoch
+            logger.info(
+                "controller recovery: restored kv=%d pgs=%d actors=%d "
+                "wal_records=%d epoch=%d",
+                self.recovery_report.get("kv", 0),
+                self.recovery_report.get("pgs", 0),
+                self.recovery_report.get("actors", 0),
+                wal_records, self.epoch,
+            )
+        if self._lease_path:
+            # claim the lease BEFORE binding: a resumed zombie's next
+            # lease read must see the higher epoch and stand down
+            self._write_lease()
+            # a takeover/restart announces its epoch to every daemon it
+            # knows from the WAL — this fences zombie writes even while
+            # the old incumbent still holds the port we want
+            if self._known_daemons:
+                asyncio.ensure_future(self._announce_to_daemons())
         if restored_port and self.server.port == 0:
             # a restarted controller rebinds its old port so daemons'
             # existing retry loops can reconnect without rediscovery
@@ -147,14 +245,18 @@ class Controller:
             port = await self.server.start()
         except OSError:
             # Old port still held — usually the predecessor's socket not
-            # yet released after a SIGKILL. The old port is the ONLY
-            # address daemons and drivers know, so spend a short patience
-            # window retrying before falling back to a fresh port (which
+            # yet released after a SIGKILL (or a deposed incumbent that
+            # hasn't self-fenced yet). The old port is the ONLY address
+            # daemons and drivers know, so spend a short patience window
+            # retrying before falling back to a fresh port (which
             # strands every existing client on the dead address).
             port = None
-            if restored_port and self.server.port == restored_port:
+            target = self.server.port
+            if target and (restored_port == target or self.takeover):
                 for _ in range(50):
                     await asyncio.sleep(0.1)
+                    if self._lease_path:
+                        self._write_lease()  # keep the claim fresh
                     try:
                         port = await self.server.start()
                         break
@@ -167,6 +269,9 @@ class Controller:
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self.persist_path:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
+        if self._lease_path:
+            self._write_lease()  # now carries the bound port
+            self._lease_task = asyncio.ensure_future(self._lease_loop())
         self._start_metrics()
         # hang defense: stall watchdog on the control-plane loop (one
         # blocked handler here wedges the whole cluster's control plane)
@@ -179,6 +284,9 @@ class Controller:
     def _snapshot(self) -> Dict[str, Any]:
         return {
             "port": getattr(self.server, "port", 0),
+            "epoch": self.epoch,
+            "daemons": dict(self._known_daemons),
+            "relocated": dict(self.relocated_objects),
             "kv": dict(self.kv),
             "jobs": dict(self.jobs),
             "named_actors": dict(self.named_actors),
@@ -205,28 +313,342 @@ class Controller:
         self._mutations += 1
 
     def _write_snapshot(self) -> None:
-        """Atomic snapshot write (tmp + rename) shared by the loop and
-        clean shutdown — a crash mid-write must never clobber the last
-        good snapshot."""
-        import os as _os
-        import pickle as _pickle
-
+        """Durable atomic snapshot write shared by the loop and clean
+        shutdown: tmp + fsync(file) + rename + fsync(dir) — a crash
+        mid-write must never clobber the last good snapshot, and a HOST
+        crash must never surface a zero-length or stale one (the
+        historical tmp+rename alone did not fsync either the bytes or
+        the directory entry). A committed snapshot is a WAL compaction
+        point: everything it captures is redundant with the log, so the
+        log truncates atomically right after. Both steps run
+        synchronously on the event loop — no mutation can interleave
+        between the state capture and the truncate."""
+        plan = active_controller_fault_plan()
+        fault = plan.consult("snapshot") if plan is not None else None
         tmp = self.persist_path + ".tmp"
         with open(tmp, "wb") as f:
-            _pickle.dump(self._snapshot(), f)
-        _os.replace(tmp, self.persist_path)
+            pickle.dump(self._snapshot(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        if fault is not None and fault[0] == "kill_mid_snapshot":
+            # die between the durable tmp write and the rename-commit:
+            # recovery must use the LAST GOOD snapshot + the full WAL
+            logger.warning("chaos: kill_mid_snapshot — SIGKILLing controller")
+            os.kill(os.getpid(), signal.SIGKILL)
+        _walmod.durable_replace(tmp, self.persist_path)
+        if self._wal is not None:
+            from ray_tpu.observability.rpc_metrics import (
+                CONTROLLER_WAL_TRUNCATIONS,
+            )
+
+            self._wal.truncate()
+            CONTROLLER_WAL_TRUNCATIONS.inc()
 
     async def _persist_loop(self) -> None:
         persisted = -1
         while not self._stopping:
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(GLOBAL_CONFIG.controller_persist_interval_s)
             if self._mutations == persisted:
                 continue  # nothing changed: skip the pickle+write churn
+            if self._lease_stale():
+                # deposed, or silent past the ack fence: a standby may
+                # own the session files now — writing OUR snapshot (and
+                # truncating the WAL the takeover replays from) would
+                # clobber the successor's state
+                continue
             try:
                 persisted = self._mutations
                 self._write_snapshot()
             except Exception:
                 logger.exception("controller snapshot failed")
+
+    # ---- write-ahead log / incarnation epoch / lease (core/wal.py) -----
+    def _wal_append(self, op: str, data: Dict[str, Any], reply=_NO_REPLY) -> None:
+        """Journal one table mutation BEFORE its RPC reply is sent (the
+        handler returns → dispatch replies → so an append inside the
+        handler always precedes the ack). ``reply`` is journaled with
+        the caller's dedup key so recovery re-seeds the exactly-once
+        reply cache. Also the self-fencing choke point: a controller
+        whose lease went stale must stop acking — a standby may already
+        own the tables."""
+        self._check_fenced()
+        self._mark_dirty()
+        if self._wal is None:
+            return
+        rec: Dict[str, Any] = {"op": op, "d": data}
+        if reply is not _NO_REPLY:
+            key = _rpc.current_dedup_key()
+            if key is not None:
+                rec["k"] = [key[0], key[1]]
+                rec["r"] = pickle.dumps(reply, protocol=5)
+        nbytes = self._wal.append(rec)
+        from ray_tpu.observability.rpc_metrics import (
+            CONTROLLER_WAL_APPENDS,
+            CONTROLLER_WAL_BYTES,
+        )
+
+        CONTROLLER_WAL_APPENDS.inc()
+        CONTROLLER_WAL_BYTES.inc(nbytes)
+        plan = active_controller_fault_plan()
+        fault = plan.consult("mutation") if plan is not None else None
+        if fault is not None and fault[0] == "kill_mid_mutation":
+            # die with the mutation logged but the reply unsent: replay
+            # must surface it and the client's retry must hit the
+            # re-seeded dedup cache, not a second execution
+            logger.warning("chaos: kill_mid_mutation — SIGKILLing controller")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def _open_and_replay_wal(self) -> int:
+        """Open the session WAL and replay every record appended since
+        the last snapshot compaction: recovery becomes byte-exact up to
+        the last acked mutation instead of the last snapshot tick."""
+        if not self._wal_path:
+            return 0
+        replayed = 0
+        try:
+            for rec in _walmod.replay(self._wal_path):
+                try:
+                    self._apply_wal_record(rec)
+                    replayed += 1
+                except Exception:
+                    logger.exception("WAL record apply failed: %r", rec.get("op"))
+        except Exception:
+            logger.exception("controller WAL replay failed")
+        self._wal = _walmod.WalWriter(
+            self._wal_path, fsync_every=GLOBAL_CONFIG.controller_wal_fsync
+        )
+        if replayed:
+            from ray_tpu.observability.rpc_metrics import CONTROLLER_WAL_REPLAYS
+
+            CONTROLLER_WAL_REPLAYS.inc(replayed)
+            if not self.recovery_report:
+                self.recovery_report = {"kv": len(self.kv), "pgs": len(self.pgs),
+                                        "actors": len(self.actors), "snapshot": False}
+        return replayed
+
+    def _apply_wal_record(self, rec: Dict[str, Any]) -> None:
+        """Re-apply one journaled mutation to the tables (inverse of the
+        ``_wal_append`` call sites), then re-seed the dedup reply cache
+        when the record journaled an acked reply."""
+        op, d = rec["op"], rec["d"]
+        if op == "kv_put":
+            self.kv[d["key"]] = d["value"]
+        elif op == "kv_del":
+            self.kv.pop(d["key"], None)
+        elif op == "actor_register":
+            spec: TaskSpec = pickle.loads(d["spec"])
+            self.actors[spec.actor_id] = ActorInfo(
+                spec=spec, state="RESTARTING", restored=True,
+            )
+            if spec.actor_name:
+                self.named_actors[(spec.namespace or "", spec.actor_name)] = spec.actor_id
+        elif op == "actor_restart":
+            info = self.actors.get(pickle.loads(d["actor_id"]))
+            if info is not None:
+                info.num_restarts = d["num_restarts"]
+        elif op == "actor_death":
+            actor_id = pickle.loads(d["actor_id"])
+            info = self.actors.get(actor_id)
+            if info is not None:
+                info.state = "DEAD"
+                info.death_reason = d.get("reason", "")
+                info.restored = False
+        elif op == "pg_create":
+            self.pgs[d["pg_id"]] = PgInfo(
+                pg_id=d["pg_id"], bundles=d["bundles"],
+                strategy=d["strategy"], name=d.get("name", ""),
+                state="RESTORING",
+            )
+            if d.get("name"):
+                self.named_pgs[d["name"]] = d["pg_id"]
+        elif op == "pg_remove":
+            info = self.pgs.pop(d["pg_id"], None)
+            if info is not None and info.name:
+                self.named_pgs.pop(info.name, None)
+            self.removed_pgs[d["pg_id"]] = None
+            while len(self.removed_pgs) > 4096:
+                self.removed_pgs.popitem(last=False)
+        elif op == "job_register":
+            self.jobs[d["job_id"]] = pickle.loads(d["info"])
+        elif op == "relocated":
+            for m in d["moves"]:
+                self.relocated_objects[m["object_id"]] = (
+                    m["node_id"], m["host"], m["port"],
+                )
+            while len(self.relocated_objects) > 65536:
+                self.relocated_objects.popitem(last=False)
+        elif op == "node_register":
+            self._known_daemons[d["node_id"]] = (d["host"], d["port"])
+        else:
+            logger.warning("unknown WAL op %r (skipped)", op)
+        key, reply = rec.get("k"), rec.get("r")
+        if key is not None and reply is not None:
+            self.server.seed_dedup(
+                (bytes(key[0]), key[1]), (_rpc.REPLY_OK, reply)
+            )
+
+    def _bump_epoch(self) -> None:
+        """Every incarnation takes a strictly higher epoch, durably,
+        BEFORE serving: fencing depends on a restart/takeover always
+        outranking its predecessor (snapshot epoch covers the case where
+        the epoch file is lost; the max of both is authoritative)."""
+        if not self._epoch_path:
+            self.epoch = 1
+            return
+        try:
+            with open(self._epoch_path, "rb") as f:
+                self.epoch = max(self.epoch, int(f.read().decode() or 0))
+        except FileNotFoundError:
+            pass
+        except Exception:
+            logger.exception("controller epoch file read failed")
+        self.epoch += 1
+        _walmod.write_durable(self._epoch_path, str(self.epoch).encode())
+        from ray_tpu.observability.rpc_metrics import CONTROLLER_EPOCH
+
+        CONTROLLER_EPOCH.set(self.epoch)
+
+    def _write_lease(self) -> None:
+        _walmod.write_lease(
+            self._lease_path,
+            epoch=self.epoch,
+            port=getattr(self.server, "port", 0),
+            pid=os.getpid(),
+            ts=time.time(),
+        )
+        self._last_lease_ok = time.time()
+
+    def _lease_stale(self) -> bool:
+        """True once this incarnation may no longer own the tables:
+        deposed outright, or its own lease heartbeat is stale past ~75%
+        of the takeover timeout — a standby assumes the lease dead at
+        100%, so distrusting ourselves strictly earlier closes the
+        split-brain window (the classic lease safety margin)."""
+        if self._fenced:
+            return True
+        if self._lease_path is None or self._lease_task is None:
+            return False
+        return (
+            time.time() - self._last_lease_ok
+            > 0.75 * GLOBAL_CONFIG.controller_lease_timeout_s
+        )
+
+    def _check_fenced(self) -> None:
+        """Mutation self-fence: refuse to ack once ``_lease_stale``.
+        Raises a ConnectionLost subclass so clients transparently retry
+        against the new incumbent."""
+        if self._fenced:
+            raise StaleControllerError(
+                f"stale_controller: epoch {self.epoch} was deposed",
+                seen_epoch=self.epoch,
+            )
+        if self._lease_stale():
+            raise StaleControllerError(
+                f"stale_controller: lease heartbeat stale (epoch {self.epoch}) "
+                "— refusing to ack mutations a standby may now own",
+                seen_epoch=self.epoch,
+            )
+
+    async def _lease_loop(self) -> None:
+        """Active-side lease heartbeat (+ the chaos hook for partition /
+        zombie_resurrect). Reads before writing: a lease claimed by a
+        HIGHER epoch means a standby took over — we are deposed and must
+        exit without touching the WAL or snapshot."""
+        interval = GLOBAL_CONFIG.controller_lease_interval_s
+        while not self._stopping:
+            await asyncio.sleep(interval)
+            now = time.time()
+            if self._silent_until:
+                if now < self._silent_until:
+                    continue  # chaos partition window: no heartbeats
+                mode, self._silent_mode = self._silent_mode, None
+                self._silent_until = 0.0
+                await self._resume_from_partition(mode)
+                continue
+            plan = active_controller_fault_plan()
+            fault = plan.consult("lease") if plan is not None else None
+            if fault is not None and fault[0] in ("partition", "zombie_resurrect"):
+                logger.warning(
+                    "chaos: %s — suppressing lease heartbeats for %.1fs",
+                    fault[0], fault[1],
+                )
+                self._silent_mode = fault[0]
+                self._silent_until = now + fault[1]
+                continue
+            lease = _walmod.read_lease(self._lease_path)
+            if lease is not None and lease.get("epoch", 0) > self.epoch:
+                self._depose(f"lease held by epoch {lease['epoch']}")
+                return
+            try:
+                self._write_lease()
+            except Exception:
+                logger.exception("lease heartbeat write failed")
+
+    async def _resume_from_partition(self, mode: Optional[str]) -> None:
+        """The deposed side of a chaos partition window. ``partition``:
+        re-read the lease; a higher-epoch claim means stand down.
+        ``zombie_resurrect``: FIRST blindly attempt a daemon write with
+        our (stale) epoch — the daemons' fencing gate must reject it
+        with ``stale_controller`` — then stand down."""
+        if mode == "zombie_resurrect":
+            fenced = await self._announce_to_daemons()
+            if fenced:
+                self._depose("zombie write fenced by daemons")
+                return
+        lease = _walmod.read_lease(self._lease_path)
+        if lease is not None and lease.get("epoch", 0) > self.epoch:
+            self._depose(f"lease held by epoch {lease['epoch']} after partition")
+            return
+        # nobody took over (no standby): resume heartbeating
+        self._write_lease()
+
+    def _depose(self, reason: str) -> None:
+        """A higher incarnation owns the cluster: stop acking, never
+        touch the WAL/snapshot again, and tell the host process to exit
+        (the standby is waiting to rebind our port)."""
+        if self._fenced:
+            return
+        self._fenced = True
+        logger.warning(
+            "controller epoch %d deposed (%s): exiting", self.epoch, reason
+        )
+        cb = self.on_deposed
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.exception("on_deposed callback failed")
+        else:
+            # standalone/no-host fallback: free the port for the
+            # incumbent — a deposed controller serving reads is a lie
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    async def _announce_to_daemons(self) -> bool:
+        """Push ``controller_hello`` (stamped with our epoch) to every
+        daemon learned from the WAL/snapshot. For a new incumbent this
+        fences the old epoch cluster-wide before we even bind the port;
+        for a resurrected zombie it is the write that MUST bounce.
+        Returns True when any daemon fenced us."""
+        fenced = False
+        for node_id, (host, dport) in list(self._known_daemons.items()):
+            client = RpcClient(host, dport, name="noded", role="noded")
+            client.fencing_epoch = self.epoch
+            try:
+                await client.call(
+                    "controller_hello",
+                    {"epoch": self.epoch, "port": getattr(self.server, "port", 0)},
+                    timeout=2.0,
+                )
+            except StaleControllerError:
+                fenced = True
+            except Exception:
+                pass  # daemon gone/unreachable — registration will sort it
+            finally:
+                try:
+                    await client.close()
+                except Exception:
+                    pass
+        return fenced
 
     def _load_snapshot(self) -> Optional[int]:
         """Restart recovery: restore KV/jobs/PGs/actors from the snapshot.
@@ -236,17 +658,17 @@ class Controller:
         sync reports them running — see ``c_sync_resources``."""
         if not self.persist_path:
             return None
-        import os as _os
-        import pickle as _pickle
-
-        if not _os.path.exists(self.persist_path):
+        if not os.path.exists(self.persist_path):
             return None
         try:
             with open(self.persist_path, "rb") as f:
-                snap = _pickle.load(f)
+                snap = pickle.load(f)
         except Exception:
             logger.exception("controller snapshot load failed")
             return None
+        self.epoch = max(self.epoch, int(snap.get("epoch", 0)))
+        self._known_daemons.update(snap.get("daemons", {}))
+        self.relocated_objects.update(snap.get("relocated", {}))
         self.kv.update(snap.get("kv", {}))
         self.jobs.update(snap.get("jobs", {}))
         self.named_actors.update(snap.get("named_actors", {}))
@@ -266,10 +688,14 @@ class Controller:
             )
         if snap.get("actors") or snap.get("pgs"):
             asyncio.ensure_future(self._reconcile_restored_state())
-        logger.info(
-            "controller restored %d kv keys, %d pgs, %d actors from snapshot",
-            len(snap.get("kv", {})), len(snap.get("pgs", {})), len(snap.get("actors", {})),
-        )
+        # the one-line summary is logged from start() once the WAL
+        # replay count and the new epoch are known
+        self.recovery_report = {
+            "kv": len(snap.get("kv", {})),
+            "pgs": len(snap.get("pgs", {})),
+            "actors": len(snap.get("actors", {})),
+            "snapshot": True,
+        }
         return snap.get("port") or None
 
     async def _reconcile_restored_state(self) -> None:
@@ -404,12 +830,33 @@ class Controller:
         from ray_tpu.observability.event_stats import remove_loop_monitor
 
         remove_loop_monitor(asyncio.get_event_loop())
+        if self._lease_task is not None:
+            self._lease_task.cancel()
         if self._persist_task is not None:
             self._persist_task.cancel()
             # final consistent snapshot on clean shutdown (atomic write:
-            # a kill mid-dump must not truncate the last good snapshot)
+            # a kill mid-dump must not truncate the last good snapshot).
+            # A DEPOSED (or lease-stale) controller skips this entirely:
+            # the snapshot and WAL belong to the new incumbent now — and
+            # the WAL still holds everything we acked, so skipping loses
+            # nothing even on a false-positive staleness read.
+            if not self._lease_stale():
+                try:
+                    self._write_snapshot()
+                except Exception:
+                    pass
+        if self._wal is not None:
+            self._wal.close()
+        if self._lease_path and not self._lease_stale():
+            # clean shutdown releases the lease (ts=0): a waiting
+            # standby promotes immediately instead of riding out the
+            # full staleness timeout
             try:
-                self._write_snapshot()
+                _walmod.write_lease(
+                    self._lease_path, epoch=self.epoch,
+                    port=getattr(self.server, "port", 0),
+                    pid=os.getpid(), ts=0.0,
+                )
             except Exception:
                 pass
         if self._metrics_server is not None:
@@ -430,6 +877,11 @@ class Controller:
 
     # ---- pubsub --------------------------------------------------------
     async def _publish(self, channel: int, payload: Any) -> None:
+        # state pushes carry the incarnation epoch: subscribers drop
+        # pushes from a deposed controller that hasn't noticed yet
+        # (core_worker-side half of epoch fencing)
+        if isinstance(payload, dict) and self.epoch:
+            payload = {**payload, "controller_epoch": self.epoch}
         # legacy all-channel subscribers ∪ explicit channel subscribers
         conns = list(self._subscribers | self._channel_subs.get(channel, set()))
 
@@ -475,8 +927,19 @@ class Controller:
             # re-registration (e.g. a dedup-window miss replaying after a
             # chaos'd reply): don't leak the old client's read task
             asyncio.ensure_future(stale.close())
-        self.node_clients[info.node_id] = RpcClient(
-            info.host, info.port, name="noded", role="noded"
+        client = RpcClient(info.host, info.port, name="noded", role="noded")
+        # controller-originated daemon writes carry the incarnation
+        # epoch: the daemon's fencing gate rejects a deposed controller
+        client.fencing_epoch = self.epoch
+        self.node_clients[info.node_id] = client
+        # journal the daemon's address: a takeover (or resurrected
+        # zombie) must be able to reach daemons BEFORE any of them
+        # re-registers — see _announce_to_daemons
+        self._known_daemons[info.node_id] = (info.host, info.port)
+        self._wal_append(
+            "node_register",
+            {"node_id": info.node_id, "host": info.host, "port": info.port},
+            reply={"ok": True},
         )
         # Re-adoption: a (re)registering daemon reports the PG bundles it
         # still holds; a restarted controller reattaches them to RESTORING
@@ -502,7 +965,8 @@ class Controller:
         if node is None:
             # restarted controller: this daemon predates us — ask it to
             # re-register (carrying its held bundles for re-adoption)
-            return {"unknown_node": True, "view": []}
+            return {"unknown_node": True, "view": [],
+                    "controller_epoch": self.epoch}
         node.available = payload["available"]
         node.total = payload.get("total", node.total)
         node.pending_leases = payload.get("pending_leases", [])
@@ -534,6 +998,10 @@ class Controller:
                     {"actor_id": a["actor_id"], "state": "ALIVE", "address": info.address},
                 )
         return {
+            # every sync reply carries the incarnation epoch, so daemons
+            # passively learn the current fencing floor without any
+            # controller-initiated write having happened yet
+            "controller_epoch": self.epoch,
             # DRAINING nodes are omitted: daemons use this view for
             # spillback targets and data block placement — neither may
             # land new work on a node about to disappear
@@ -652,6 +1120,9 @@ class Controller:
         drained = node.state == "DRAINING"
         node.alive = False
         node.state = "DEAD"
+        # a dead daemon is no longer an announce target (in-memory only:
+        # a re-registration re-journals it)
+        self._known_daemons.pop(node.node_id, None)
         logger.warning("node %s dead: %s", node.node_id.hex()[:8], reason)
         await self._publish(
             NODE_PUSH_CHANNEL,
@@ -735,6 +1206,7 @@ class Controller:
         """Draining daemon reports shm objects it replicated to a peer:
         {moves: [{object_id, node_id, host, port}]}. Owners consult this
         (``get_relocated``) when their cached locations go stale."""
+        self._wal_append("relocated", {"moves": payload["moves"]}, reply=True)
         for m in payload["moves"]:
             self.relocated_objects[m["object_id"]] = (
                 m["node_id"], m["host"], m["port"],
@@ -766,7 +1238,10 @@ class Controller:
                         f"namespace {spec.namespace!r}"
                     )
             self.named_actors[key] = spec.actor_id
-        self._mark_dirty()
+        self._wal_append(
+            "actor_register", {"spec": pickle.dumps(spec, protocol=5)},
+            reply={"ok": True},
+        )
         asyncio.ensure_future(self._schedule_actor(spec.actor_id))
         return {"ok": True}
 
@@ -863,7 +1338,12 @@ class Controller:
         ) and not self._stopping:
             if not budget_free:
                 info.num_restarts += 1
-                self._mark_dirty()
+                self._wal_append(
+                    "actor_restart",
+                    {"actor_id": pickle.dumps(actor_id, protocol=5),
+                     "num_restarts": info.num_restarts},
+                    reply={"ok": True},
+                )
             info.state = "RESTARTING"
             info.address = None
             await self._publish(
@@ -884,7 +1364,13 @@ class Controller:
             return
         info.state = "DEAD"
         info.death_reason = reason
-        self._mark_dirty()  # DEAD actors leave the snapshot
+        # DEAD actors leave the snapshot; the WAL records the death so a
+        # replayed register+death nets out DEAD, not a ghost restart
+        self._wal_append(
+            "actor_death",
+            {"actor_id": pickle.dumps(actor_id, protocol=5), "reason": reason},
+            reply={"ok": True},
+        )
         await self._publish(
             ACTOR_PUSH_CHANNEL,
             {"actor_id": actor_id, "state": "DEAD", "reason": reason, "error": creation_error},
@@ -962,7 +1448,12 @@ class Controller:
         self.pgs[pg_id] = info
         if info.name:
             self.named_pgs[info.name] = pg_id
-        self._mark_dirty()
+        self._wal_append(
+            "pg_create",
+            {"pg_id": pg_id, "bundles": info.bundles,
+             "strategy": info.strategy, "name": info.name},
+            reply={"ok": True},
+        )
         asyncio.ensure_future(self._schedule_pg(pg_id))
         return {"ok": True}
 
@@ -1065,7 +1556,7 @@ class Controller:
         info.state = "REMOVED"
         if info.name:
             self.named_pgs.pop(info.name, None)
-        self._mark_dirty()
+        self._wal_append("pg_remove", {"pg_id": pg_id}, reply={"ok": True})
         # Drop the table entry: long-lived clusters cycle many PGs and the
         # table would otherwise grow without bound. A bounded tombstone
         # lets racing clients tell "removed" apart from "never existed".
@@ -1227,6 +1718,16 @@ class Controller:
         for ev in self.task_events.values():
             task_summary[ev["state"]] = task_summary.get(ev["state"], 0) + 1
         return {
+            # control-plane durability/failover facts: incarnation
+            # epoch, whether this incarnation is a promoted standby, and
+            # the recovery report (operators verify a takeover restored
+            # the WAL tip — wal_records > 0 — not just a stale snapshot)
+            "controller": {
+                "epoch": self.epoch,
+                "takeover": self.takeover,
+                "recovery": dict(self.recovery_report),
+                "wal_appends": self._wal.appended if self._wal is not None else 0,
+            },
             "nodes": await self.c_nodes(None, conn),
             "actors": await self.c_list_actors(None, conn),
             "tasks": {
@@ -1287,17 +1788,21 @@ class Controller:
 
     # ---- kv ------------------------------------------------------------
     async def c_kv_put(self, payload, conn):
+        self._wal_append(
+            "kv_put", {"key": payload["key"], "value": payload["value"]},
+            reply=True,
+        )
         self.kv[payload["key"]] = payload["value"]
-        self._mark_dirty()
         return True
 
     async def c_kv_get(self, payload, conn):
         return self.kv.get(payload["key"])
 
     async def c_kv_del(self, payload, conn):
-        existed = self.kv.pop(payload["key"], None) is not None
+        existed = payload["key"] in self.kv
         if existed:
-            self._mark_dirty()
+            self._wal_append("kv_del", {"key": payload["key"]}, reply=True)
+            self.kv.pop(payload["key"], None)
         return existed
 
     async def c_kv_keys(self, payload, conn):
@@ -1306,8 +1811,14 @@ class Controller:
 
     # ---- jobs ----------------------------------------------------------
     async def c_register_job(self, payload, conn):
-        self.jobs[payload["job_id"]] = {"start_time": time.time(), **payload}
-        self._mark_dirty()
+        info = {"start_time": time.time(), **payload}
+        self._wal_append(
+            "job_register",
+            {"job_id": payload["job_id"],
+             "info": pickle.dumps(info, protocol=5)},
+            reply=True,
+        )
+        self.jobs[payload["job_id"]] = info
         return True
 
     async def c_ping(self, payload, conn):

@@ -9,11 +9,21 @@ and come back from its snapshot while every node daemon, worker, and
 driver stays up and reconnects.
 
 On restart with the same ``--session-dir``, ``Controller._load_snapshot``
-restores the KV / job / PG / actor tables AND the old listening port, so
-existing clients reconnect to the same address with no rediscovery;
-daemons re-register (carrying held bundles and running actors for
-re-adoption) the moment their next resource sync returns
-``unknown_node``.
+restores the KV / job / PG / actor tables AND the old listening port,
+``Controller._open_and_replay_wal`` replays every mutation acked since
+the last snapshot tick (core/wal.py), so existing clients reconnect to
+the same address with no rediscovery and no loss window; daemons
+re-register (carrying held bundles and running actors for re-adoption)
+the moment their next resource sync returns ``unknown_node``.
+
+``--standby`` runs the HOT-STANDBY topology instead: the process tails
+the shared session dir's WAL (warming the page cache toward the tip)
+and watches the active's lease heartbeats; when the lease goes stale —
+crash — or is released (``ts=0``, clean shutdown), it replays snapshot +
+WAL to the tip, takes a strictly higher incarnation epoch, announces
+itself to every known daemon (fencing the old epoch cluster-wide), and
+rebinds the old port. Sub-second-after-lease-expiry failover versus an
+operator-driven restart.
 """
 
 from __future__ import annotations
@@ -25,6 +35,49 @@ import logging
 import os
 import signal
 import sys
+import time
+
+logger = logging.getLogger("ray_tpu.controller_main")
+
+
+async def _standby_wait(session_dir: str, stop: asyncio.Event) -> bool:
+    """Follower loop: poll the lease + tail the WAL until the lease goes
+    stale/released (return True = promote) or ``stop`` fires (False)."""
+    from ray_tpu.core import wal as walmod
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    lease_path = os.path.join(session_dir, "controller.lease")
+    wal_path = os.path.join(session_dir, "controller.wal")
+    interval = GLOBAL_CONFIG.controller_lease_interval_s
+    timeout = GLOBAL_CONFIG.controller_lease_timeout_s
+    tail_offset, tail_records = 0, 0
+    ever_saw_lease = False
+    while not stop.is_set():
+        lease = walmod.read_lease(lease_path)
+        if lease is not None:
+            ever_saw_lease = True
+            ts = lease.get("ts", 0.0)
+            if ts == 0.0:
+                logger.info("active released the lease (clean stop): promoting")
+                return True
+            if time.time() - ts > timeout:
+                logger.warning(
+                    "lease stale by %.2fs (epoch %d, pid %d): promoting",
+                    time.time() - ts, lease.get("epoch", 0), lease.get("pid", 0),
+                )
+                return True
+        elif ever_saw_lease:
+            # lease file vanished after being held — treat as released
+            return True
+        tail_offset, n = walmod.scan_tip(wal_path, tail_offset)
+        tail_records += n
+        if n:
+            logger.debug("tailed %d WAL records (total %d)", n, tail_records)
+        try:
+            await asyncio.wait_for(stop.wait(), timeout=interval)
+        except asyncio.TimeoutError:
+            pass
+    return False
 
 
 async def amain(args) -> None:
@@ -37,9 +90,6 @@ async def amain(args) -> None:
     if args.session_dir:
         os.makedirs(args.session_dir, exist_ok=True)
         persist = os.path.join(args.session_dir, "controller_snapshot.pkl")
-    controller = Controller(port=args.port, persist_path=persist)
-    cport = await controller.start()
-    print(json.dumps({"controller_port": cport}), flush=True)
 
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()
@@ -50,6 +100,51 @@ async def amain(args) -> None:
     from ray_tpu.util.reaper import start_orphan_watch
 
     start_orphan_watch(lambda: loop.call_soon_threadsafe(stop.set))
+
+    takeover = False
+    if args.standby:
+        if not args.session_dir:
+            raise SystemExit("--standby requires --session-dir")
+        # handshake immediately (the spawner is blocked on this line):
+        # report the port the ACTIVE currently serves — the one this
+        # standby will rebind on takeover
+        from ray_tpu.core import wal as walmod
+
+        lease = walmod.read_lease(
+            os.path.join(args.session_dir, "controller.lease")
+        )
+        print(json.dumps({
+            "controller_port": (lease or {}).get("port", 0),
+            "standby": True,
+        }), flush=True)
+        if not await _standby_wait(args.session_dir, stop):
+            return  # stopped while still a follower
+        takeover = True
+        if not args.port:
+            # rebind the port the active served on (the lease carries
+            # it even when no snapshot tick ever recorded one)
+            lease = walmod.read_lease(
+                os.path.join(args.session_dir, "controller.lease")
+            )
+            args.port = (lease or {}).get("port", 0)
+
+    controller = Controller(port=args.port, persist_path=persist,
+                            takeover=takeover)
+    # a deposed incarnation (higher epoch claimed the lease) must exit so
+    # its successor can rebind the port — trip the process stop event
+    controller.on_deposed = lambda: loop.call_soon_threadsafe(stop.set)
+    cport = await controller.start()
+    if takeover:
+        from ray_tpu.observability.rpc_metrics import CONTROLLER_TAKEOVERS
+
+        CONTROLLER_TAKEOVERS.inc()
+        logger.warning(
+            "standby promoted: epoch=%d port=%d recovery=%r",
+            controller.epoch, cport, controller.recovery_report,
+        )
+    else:
+        print(json.dumps({"controller_port": cport}), flush=True)
+
     await stop.wait()
     await controller.stop()
 
@@ -63,6 +158,9 @@ def main() -> None:
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--session-dir", type=str, default=None)
     parser.add_argument("--system-config", type=str, default="")
+    parser.add_argument("--standby", action="store_true",
+                        help="run as a hot standby tailing the session "
+                             "dir's WAL; promote on lease expiry")
     args = parser.parse_args()
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),

@@ -130,6 +130,10 @@ class CoreWorker(RuntimeBackend):
         self.address: Optional[Address] = None
         self._actors: Dict[ActorID, _ActorState] = {}
         self._actors_lock = threading.Lock()
+        #: highest controller incarnation epoch seen on state pushes —
+        #: pushes stamped lower come from a deposed controller racing
+        #: its own takeover and are dropped (worker half of fencing)
+        self._controller_epoch_seen = 0
         self._clients: Dict[Tuple[str, int], RpcClient] = {}
         self._pg_states: Dict[bytes, str] = {}
         self._pg_events: Dict[bytes, threading.Event] = {}
@@ -1557,7 +1561,27 @@ class CoreWorker(RuntimeBackend):
             st.creation_spec = spec
         self.io.run(self.controller.call("register_actor", {"spec": spec}))
 
+    def _stale_controller_push(self, msg: Dict[str, Any]) -> bool:
+        """Worker half of controller epoch fencing: state pushes carry
+        the sender's incarnation epoch (controller._publish). Track the
+        highest seen; drop anything lower — it was emitted by a deposed
+        controller racing its own takeover, and applying it would roll
+        actor/node/PG state back behind the new incumbent's."""
+        epoch = msg.get("controller_epoch", 0)
+        if not epoch:
+            return False  # ephemeral (no-persistence) controller
+        if epoch < self._controller_epoch_seen:
+            logger.warning(
+                "dropping stale controller push (epoch %d < %d)",
+                epoch, self._controller_epoch_seen,
+            )
+            return True
+        self._controller_epoch_seen = epoch
+        return False
+
     def _on_actor_push(self, msg: Dict[str, Any]) -> None:
+        if self._stale_controller_push(msg):
+            return
         actor_id = msg["actor_id"]
         with self._actors_lock:
             st = self._actors.setdefault(actor_id, _ActorState())
@@ -1574,6 +1598,8 @@ class CoreWorker(RuntimeBackend):
         """Controller-pushed node membership/state changes. Libraries
         (Train's drain watch, Serve) register listeners to react to
         DRAINING the moment the warning lands, not on a poll interval."""
+        if self._stale_controller_push(msg):
+            return
         nid = msg.get("node_id")
         if nid is not None:
             if msg.get("alive"):
@@ -1611,6 +1637,8 @@ class CoreWorker(RuntimeBackend):
         # waited on): pushes are cluster-wide, so caching every one would
         # grow without bound in long-lived workers under PG churn. Waiters
         # that miss a push recover via the poll fallback in wait_pg_ready.
+        if self._stale_controller_push(msg):
+            return
         ev = self._pg_events.get(msg["pg_id"])
         if ev is None:
             return

@@ -108,6 +108,11 @@ class NodeDaemon:
         self.node_id = NodeID.from_random()
         self.host = host
         self.server = RpcServer(host, port)
+        #: highest controller incarnation epoch this daemon has seen;
+        #: the server-side fencing gate rejects writes stamped lower
+        #: (a deposed controller double-writing after a takeover)
+        self._controller_epoch_seen = 0
+        self.server.epoch_gate = self._controller_epoch_gate
         # retry-by-default toward the control plane: every mutating call
         # is dedup-stamped (core/rpc.py), so surviving a controller
         # restart or a chaos'd reply is a transparent retry, not an error
@@ -618,6 +623,9 @@ class NodeDaemon:
                     },
                     timeout=5,
                 )
+                # passive fencing-floor update: every sync reply carries
+                # the current controller incarnation epoch
+                self._note_controller_epoch(reply.get("controller_epoch", 0))
                 if reply.get("unknown_node"):
                     # controller restarted and lost node membership:
                     # re-register (carrying held bundles for re-adoption)
@@ -1490,6 +1498,51 @@ class NodeDaemon:
                 host, port, name=f"peer-{port}", role="noded"
             )
         return client
+
+    # ---- controller fencing (epoch gate) -------------------------------
+    def _note_controller_epoch(self, epoch: int) -> None:
+        if epoch > self._controller_epoch_seen:
+            if self._controller_epoch_seen:
+                logger.info(
+                    "controller epoch %d -> %d (restart/takeover)",
+                    self._controller_epoch_seen, epoch,
+                )
+            self._controller_epoch_seen = epoch
+
+    def _controller_epoch_gate(self, method: str, epoch: int):
+        """RpcServer fencing gate (core/rpc.py meta slot 3): record the
+        highest controller epoch seen; reject anything lower with a
+        structured ``stale_controller`` error — the deposed controller
+        takes it as the order to exit. Split-brain writes become a
+        counted non-event instead of silent state corruption."""
+        if epoch < self._controller_epoch_seen:
+            from ray_tpu.observability.rpc_metrics import (
+                CONTROLLER_FENCED_WRITES,
+            )
+
+            CONTROLLER_FENCED_WRITES.inc()
+            logger.warning(
+                "fenced stale controller write %s (epoch %d < %d)",
+                method, epoch, self._controller_epoch_seen,
+            )
+            from ray_tpu.core.rpc import StaleControllerError
+
+            return StaleControllerError(
+                f"stale_controller: write {method!r} carries epoch {epoch} "
+                f"but epoch {self._controller_epoch_seen} has taken over — "
+                "the deposed controller must exit",
+                seen_epoch=self._controller_epoch_seen,
+            )
+        self._note_controller_epoch(epoch)
+        return None
+
+    async def d_controller_hello(self, payload, conn):
+        """A (new or resurrected) controller announces itself. A new
+        incumbent's hello raises the fencing floor cluster-wide before
+        it even binds the service port; a zombie's hello is exactly the
+        write the epoch gate bounces (it never reaches this handler)."""
+        return {"ok": True, "node_id": self.node_id.binary(),
+                "epoch_seen": self._controller_epoch_seen}
 
     # ---- misc ----------------------------------------------------------
     async def d_ping(self, payload, conn):

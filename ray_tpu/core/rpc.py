@@ -62,6 +62,7 @@ accepts for its GCS-side dedup tables.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
 import os
 import pickle
@@ -210,6 +211,38 @@ class ChaosInjectedError(ConnectionLost):
     handler runs (a retry never double-executes); ``reply_drop`` faults
     fire AFTER — the handler ran, and only the request-id dedup cache
     makes the retry safe for mutating methods."""
+
+
+class StaleControllerError(ConnectionLost):
+    """A write stamped with a controller incarnation epoch LOWER than
+    the highest the receiver has seen (``stale_controller``): the sender
+    is a deposed controller and must exit instead of double-writing.
+    Also raised by a controller that lost its OWN lease (self-fencing —
+    it stops acking mutations before a standby can assume the lease
+    expired). A ConnectionLost subclass so ordinary clients caught in a
+    failover window simply retry and land on the new incumbent; a
+    deposed controller's daemon clients run with zero retries, so the
+    fence surfaces to it directly."""
+
+    def __init__(self, msg: str, *, seen_epoch: int = 0):
+        super().__init__(msg)
+        #: highest epoch the rejecting side had seen (0 = unknown)
+        self.seen_epoch = seen_epoch
+
+
+#: the (client_id, request_id) dedup key of the RPC currently being
+#: executed by this task's handler, or None. The controller's WAL reads
+#: it to journal the acked reply alongside the mutation, so replay
+#: re-seeds the exactly-once cache (see core/wal.py).
+_CURRENT_DEDUP_KEY: contextvars.ContextVar = contextvars.ContextVar(
+    "rpc_dedup_key", default=None
+)
+
+
+def current_dedup_key() -> Optional[Tuple[bytes, int]]:
+    """Dedup key of the in-flight RPC on this task, if any (handlers
+    only — None outside a deduped dispatch)."""
+    return _CURRENT_DEDUP_KEY.get()
 
 
 #: Linux-only privileged setsockopt variants that bypass wmem_max/rmem_max
@@ -498,6 +531,12 @@ class RpcServer:
         self._dedup_done: "OrderedDict[Tuple[bytes, int], Tuple[int, bytes]]" = OrderedDict()
         self._dedup_bytes = 0
         self._dedup_inflight: Dict[Tuple[bytes, int], asyncio.Future] = {}
+        #: optional fencing gate ``(method_name, epoch) -> Optional[
+        #: Exception]`` consulted for requests stamped with a controller
+        #: incarnation epoch (meta slot 3). Daemons install one that
+        #: tracks the highest epoch seen and rejects lower-epoch writes
+        #: with StaleControllerError — see core/node_daemon.py.
+        self.epoch_gate: Optional[Callable[[str, int], Optional[Exception]]] = None
 
     def register(self, method: str, handler) -> None:
         self._handlers[method.encode()] = handler
@@ -599,6 +638,18 @@ class RpcServer:
             # ids start at 1) — idempotent methods under an active
             # trace still carry the context without entering the cache.
             trace_wire = meta[2] if meta is not None and len(meta) > 2 else None
+            # --- epoch fencing (meta slot 3) --------------------------
+            # Only controllers stamp an incarnation epoch, so a present
+            # epoch + an installed gate means "controller-originated
+            # write": the gate records the highest epoch seen and
+            # rejects lower ones BEFORE dedup/execution — a deposed
+            # controller's write must not execute OR consume a dedup
+            # slot.
+            wire_epoch = meta[3] if meta is not None and len(meta) > 3 else None
+            if wire_epoch is not None and self.epoch_gate is not None:
+                gate_err = self.epoch_gate(method_name, wire_epoch)
+                if gate_err is not None:
+                    raise gate_err
             dedup_key = None
             if meta is not None and meta[1]:
                 dedup_key = (bytes(meta[0]), meta[1])
@@ -626,6 +677,10 @@ class RpcServer:
                 self._dedup_inflight[dedup_key] = fut
             # --- execute ----------------------------------------------
             raw_result: Optional[RawPayload] = None
+            # expose the dedup key to the handler (this dispatch runs in
+            # its own task, so the set is task-local): the controller
+            # WAL journals it with the mutation for replay re-seeding
+            _dedup_token = _CURRENT_DEDUP_KEY.set(dedup_key)
             try:
                 try:
                     arg = pickle.loads(payload) if payload else None
@@ -671,6 +726,7 @@ class RpcServer:
                     if fut is not None and not fut.done():
                         fut.set_result(record)
             finally:
+                _CURRENT_DEDUP_KEY.reset(_dedup_token)
                 # a cancelled execution (server stopping) must not leave
                 # duplicate waiters parked on a future nobody resolves
                 if dedup_key is not None:
@@ -701,6 +757,13 @@ class RpcServer:
                 started_at - enqueued_at if enqueued_at else 0.0,
                 time.monotonic() - started_at,
             )
+
+    def seed_dedup(self, key: Tuple[bytes, int], record: Tuple[int, bytes]) -> None:
+        """Pre-populate the reply cache (controller WAL replay): a
+        client retrying a mutation it acked against the PREVIOUS
+        incarnation gets the journaled reply instead of a second
+        execution — exactly-once survives failover."""
+        self._dedup_record(key, record)
 
     def _dedup_record(self, key: Tuple[bytes, int], record: Tuple[int, bytes]) -> None:
         """Resolve duplicate waiters and cache the reply, bounded by the
@@ -927,6 +990,10 @@ class RpcClient:
         #: invoked (as a task) after every RE-connect — the hook for
         #: re-subscribing push channels / replaying session state
         self.on_reconnect: Optional[Callable[[], Awaitable[Any]]] = None
+        #: controller incarnation epoch stamped on every outgoing call
+        #: (meta slot 3). Set ONLY on clients owned by a controller —
+        #: receivers with an installed ``epoch_gate`` fence stale ones.
+        self.fencing_epoch: Optional[int] = None
         self._ever_connected = False
         self._reader = None
         self._writer = None
@@ -1218,17 +1285,23 @@ class RpcClient:
         if raw_into is not None:
             self._raw_sinks[seq] = raw_into
         try:
-            # meta = [client_id, request_id, trace_ctx?]: request_id 0 is
-            # the trace-only sentinel (no dedup); untraced calls without
-            # a request id stay meta-less — the unsampled wire format is
-            # byte-identical to before tracing existed
+            # meta = [client_id, request_id, trace_ctx?, epoch?]:
+            # request_id 0 is the trace-only sentinel (no dedup);
+            # untraced/unfenced calls without a request id stay
+            # meta-less — the common wire format is byte-identical to
+            # before tracing/fencing existed. The fencing epoch (set
+            # only on controller-owned clients) rides slot 3, padding
+            # the trace slot with None when untraced.
             trace = _tracing.current_wire()
-            if request_id is None and trace is None:
+            epoch = self.fencing_epoch
+            if request_id is None and trace is None and epoch is None:
                 meta = None
             else:
                 meta = [self.client_id, request_id or 0]
-                if trace is not None:
-                    meta.append(list(trace))
+                if trace is not None or epoch is not None:
+                    meta.append(list(trace) if trace is not None else None)
+                if epoch is not None:
+                    meta.append(epoch)
             body = _encode_body(
                 REQUEST,
                 seq,

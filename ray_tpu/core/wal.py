@@ -1,0 +1,210 @@
+"""Controller write-ahead log (control-plane durability substrate).
+
+Reference: the GCS backs its tables with a replicated store precisely
+because every other recovery path recovers *through* it.  Our controller
+persisted via a periodic dirty-snapshot loop, which leaves a loss window
+of up to one snapshot period: a SIGKILL between ticks silently drops
+every acked table mutation since the last write.  This module closes the
+window with a classic WAL:
+
+- every mutation appends one compact msgpack record *before* the RPC
+  reply is sent (``Controller._wal_append``), so recovery is byte-exact
+  up to the last acked mutation;
+- the existing snapshot becomes a **compaction point**: after a snapshot
+  commits durably, the log is atomically truncated (``WalWriter.
+  truncate``) — replay-after-restart is exactly snapshot + the records
+  appended since;
+- records optionally carry the (client_id, request_id) dedup key and the
+  pickled reply, so replay re-seeds the RPC server's exactly-once reply
+  cache: a client retrying an acked mutation across a failover gets the
+  cached reply, never a re-execution.
+
+Framing is ``<crc32><len><msgpack body>`` per record; replay stops at
+the first torn/corrupt frame (a crash mid-append loses only the unacked
+tail — that record's reply was never sent).  Durability policy is the
+``controller_wal_fsync`` knob: fsync every N appends (1 = every record,
+the default), 0 = flush to the OS only (crash-of-process safe, not
+crash-of-host safe).
+
+``fsync_file_and_dir``/``durable_replace`` are shared with the snapshot
+writer: the historical tmp+rename snapshot never fsynced the tmp file or
+the directory entry, so a host crash could surface a zero-length "last
+good snapshot".
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import zlib
+from typing import Any, Iterator, Optional
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+#: per-record frame header: crc32(body), len(body)
+_HDR = struct.Struct("<II")
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory containing ``path`` so a rename/create of the
+    entry itself survives a host crash (POSIX: rename durability needs a
+    directory fsync, not just the file's)."""
+    dirname = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds — best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_durable(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` via tmp + fsync(file) + rename +
+    fsync(dir): the commit point is the rename, and both the bytes and
+    the directory entry are on stable storage afterwards."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    durable_replace(tmp, path)
+
+
+def durable_replace(tmp: str, dst: str) -> None:
+    """Atomic rename-commit with directory durability. The tmp file must
+    already be written and fsynced by the caller."""
+    os.replace(tmp, dst)
+    fsync_dir(dst)
+
+
+def pack_record(record: Any) -> bytes:
+    """Frame one record: header(crc, len) + msgpack body."""
+    body = msgpack.packb(record, use_bin_type=True)
+    return _HDR.pack(zlib.crc32(body), len(body)) + body
+
+
+class WalWriter:
+    """Append-only framed record log with an fsync-every-N policy and an
+    atomic truncate used at snapshot compaction points."""
+
+    def __init__(self, path: str, fsync_every: int = 1):
+        self.path = path
+        #: fsync every N appends; 0 disables fsync (flush only)
+        self.fsync_every = fsync_every
+        self._f = open(path, "ab")
+        self._since_sync = 0
+        #: records appended by THIS writer (not the on-disk total)
+        self.appended = 0
+
+    def append(self, record: Any) -> int:
+        """Append one record and apply the fsync policy; returns the
+        framed size in bytes. The record is durable (per policy) when
+        this returns — callers ack only after."""
+        frame = pack_record(record)
+        self._f.write(frame)
+        self._f.flush()
+        self.appended += 1
+        self._since_sync += 1
+        if self.fsync_every > 0 and self._since_sync >= self.fsync_every:
+            os.fsync(self._f.fileno())
+            self._since_sync = 0
+        return len(frame)
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def truncate(self) -> None:
+        """Compaction point: atomically restart the log as empty. Uses
+        the durable tmp+rename helper so a crash mid-truncate leaves
+        either the old log or the new empty one, never a torn file."""
+        self._f.close()
+        write_durable(self.path, b"")
+        self._f = open(self.path, "ab")
+        self._since_sync = 0
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+
+def replay(path: str) -> Iterator[Any]:
+    """Yield every intact record in ``path`` in append order, stopping
+    cleanly at the first torn or corrupt frame (crash-truncated tail —
+    by construction that record was never acked)."""
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    n = len(data)
+    while off + _HDR.size <= n:
+        crc, ln = _HDR.unpack_from(data, off)
+        body = data[off + _HDR.size: off + _HDR.size + ln]
+        if len(body) < ln or zlib.crc32(body) != crc:
+            logger.warning(
+                "WAL %s: torn tail at offset %d (%d trailing bytes dropped)",
+                path, off, n - off,
+            )
+            return
+        yield msgpack.unpackb(body, raw=False)
+        off += _HDR.size + ln
+
+
+def scan_tip(path: str, offset: int = 0) -> "tuple[int, int]":
+    """Standby tailer: count intact records from ``offset`` without
+    deserializing bodies. Returns (new_offset, records_seen) — warms the
+    page cache so takeover replay reads hot data."""
+    if not os.path.exists(path):
+        return 0, 0
+    count = 0
+    with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
+        if offset > size:
+            offset = 0  # log truncated (compaction) — restart from head
+        f.seek(offset)
+        while True:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                break
+            crc, ln = _HDR.unpack_from(hdr, 0)
+            body = f.read(ln)
+            if len(body) < ln or zlib.crc32(body) != crc:
+                break
+            offset += _HDR.size + ln
+            count += 1
+    return offset, count
+
+
+# ---- lease file (standby failover) ------------------------------------
+
+def read_lease(path: str) -> Optional[dict]:
+    """Best-effort lease read; None if absent/torn (writers use atomic
+    tmp+rename so torn reads only happen on exotic filesystems)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+        return msgpack.unpackb(data, raw=False)
+    except Exception:
+        return None
+
+
+def write_lease(path: str, *, epoch: int, port: int, pid: int, ts: float) -> None:
+    """Atomic lease stamp. No fsync: the lease is a liveness signal, not
+    durable state — a host crash invalidates it by going silent anyway."""
+    tmp = path + f".tmp.{pid}"
+    with open(tmp, "wb") as f:
+        f.write(msgpack.packb(
+            {"epoch": epoch, "port": port, "pid": pid, "ts": ts},
+            use_bin_type=True,
+        ))
+    os.replace(tmp, path)

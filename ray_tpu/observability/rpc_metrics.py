@@ -57,6 +57,49 @@ CONTROLLER_RECONNECTS = Counter(
     ("role",),
 )
 
+# -- controller durability / failover (core/controller.py, core/wal.py) -----
+# The zero-loss control plane: WAL volume (appends/bytes), compaction
+# cadence (truncations at snapshot points), recovery depth (records
+# replayed at start/takeover), the fencing epoch, standby promotions,
+# and how many stale-epoch writes daemons rejected (each one is a
+# split-brain write that WOULD have corrupted tables).
+
+#: records appended to the controller WAL (one per acked table mutation)
+CONTROLLER_WAL_APPENDS = Counter(
+    "raytpu_controller_wal_appends_total",
+    "controller WAL records appended (one per acked table mutation)",
+)
+#: framed bytes appended to the controller WAL
+CONTROLLER_WAL_BYTES = Counter(
+    "raytpu_controller_wal_bytes_total",
+    "framed bytes appended to the controller WAL",
+)
+#: WAL records replayed during controller recovery (restart or takeover)
+CONTROLLER_WAL_REPLAYS = Counter(
+    "raytpu_controller_wal_replays_total",
+    "controller WAL records replayed at recovery (restart/takeover)",
+)
+#: WAL compactions: snapshot commits that truncated the log
+CONTROLLER_WAL_TRUNCATIONS = Counter(
+    "raytpu_controller_wal_truncations_total",
+    "controller WAL truncations (snapshot compaction points)",
+)
+#: this controller's incarnation epoch (the fencing token daemons check)
+CONTROLLER_EPOCH = Gauge(
+    "raytpu_controller_epoch",
+    "controller incarnation epoch (fencing token; bumps every start/takeover)",
+)
+#: hot-standby promotions (lease expiry observed → replayed → serving)
+CONTROLLER_TAKEOVERS = Counter(
+    "raytpu_controller_takeovers_total",
+    "standby controller takeovers (lease-expiry promotions)",
+)
+#: stale-epoch controller writes rejected by a daemon's fencing gate
+CONTROLLER_FENCED_WRITES = Counter(
+    "raytpu_controller_fenced_writes_total",
+    "stale-epoch controller writes rejected by epoch fencing",
+)
+
 # -- pull manager (core/pull_manager.py) ------------------------------------
 # The data plane's fault-tolerance activity: how many chunks moved, how
 # often a chunk was retried (and why), how often a transfer failed over

@@ -456,6 +456,121 @@ class KvTierFaultPlan:
         return None
 
 
+#: Controller (control-plane) chaos fault modes, consulted by
+#: ``core/controller.py`` once per executed control-plane phase.
+#: kill_mid_mutation — SIGKILL the controller right after a WAL append
+#:   commits but before the RPC reply is sent: the mutation is logged
+#:   but unacked — recovery must surface it (replay) and the client's
+#:   retry must dedup against the re-seeded reply cache.
+#: kill_mid_snapshot — SIGKILL between the snapshot tmp write and the
+#:   rename-commit: recovery must use the LAST GOOD snapshot plus the
+#:   full (untruncated) WAL.
+#: partition — the active controller stops lease heartbeats for
+#:   ``param`` seconds (partitioned from the shared session dir): a hot
+#:   standby takes over; on resume the old active self-fences via the
+#:   lease file and exits without touching the WAL.
+#: zombie_resurrect — like ``partition``, but on resume the deposed
+#:   controller first attempts a daemon write (``controller_hello``)
+#:   with its stale epoch: daemons must reject it with
+#:   ``stale_controller`` (counted in
+#:   ``raytpu_controller_fenced_writes_total``), then it exits.
+CONTROLLER_FAULT_MODES = (
+    "kill_mid_mutation",
+    "kill_mid_snapshot",
+    "partition",
+    "zombie_resurrect",
+)
+
+
+class ControllerFaultPlan:
+    """Seeded control-plane fault plan (``RAY_TPU_testing_controller_chaos``).
+
+    Spec grammar (same shape as :class:`ReplicaFaultPlan`)::
+
+        "<mode>:<prob>[:<param>][:<max>][, ...]"
+
+    ``param`` — for the kill modes: matching-phase consults to SKIP
+    before the rule becomes eligible (default 0), which lets a test land
+    the kill mid-burst instead of on the first mutation; for
+    ``partition``/``zombie_resurrect``: seconds of lease silence
+    (default 2.0). ``max`` — per-process injection cap (default 1).
+
+    Consults happen once per control-plane phase that executes:
+    ``consult("mutation")`` per WAL append, ``consult("snapshot")`` per
+    snapshot write, ``consult("lease")`` per lease heartbeat tick.
+
+    DETERMINISM CONTRACT (same as :class:`RpcFaultPlan`): exactly one
+    RNG draw per consult, whether or not any rule matches — the full
+    injection schedule is a pure function of (seed, ordered consulted
+    phases), so a failure log carrying seed + spec replays exactly.
+    """
+
+    #: which phase each mode fires in
+    _PHASE = {
+        "kill_mid_mutation": "mutation",
+        "kill_mid_snapshot": "snapshot",
+        "partition": "lease",
+        "zombie_resurrect": "lease",
+    }
+
+    def __init__(self, spec: str, seed: int):
+        self.spec = spec
+        self.seed = seed
+        #: [mode, prob, param, max_injections]
+        self.rules: List[List[float]] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) < 2:
+                raise ValueError(
+                    f"bad controller chaos rule {part!r} (need mode:prob)"
+                )
+            mode, prob = fields[0], float(fields[1])
+            if mode not in CONTROLLER_FAULT_MODES:
+                raise ValueError(
+                    f"unknown controller chaos mode {mode!r} "
+                    f"(one of {CONTROLLER_FAULT_MODES})"
+                )
+            param = float(fields[2]) if len(fields) > 2 else (
+                0.0 if mode.startswith("kill_") else 2.0
+            )
+            cap = int(fields[3]) if len(fields) > 3 else 1
+            self.rules.append([mode, prob, param, cap])
+        self._rng = random.Random(seed)
+        self.consults = 0
+        self.injections = 0
+        self._phase_consults = [0] * len(self.rules)
+        self._injected = [0] * len(self.rules)
+
+    @classmethod
+    def _matches(cls, mode: str, phase: str) -> bool:
+        return cls._PHASE[mode] == phase
+
+    def consult(self, phase: str) -> Optional[Tuple[str, float]]:
+        """One deterministic consult for a control-plane phase
+        (``"mutation"`` | ``"snapshot"`` | ``"lease"``): ``(mode,
+        param)`` to inject, else None. Exactly one RNG draw regardless
+        of outcome."""
+        draw = self._rng.random()  # ALWAYS one draw (see class docstring)
+        self.consults += 1
+        for i, (mode, prob, param, cap) in enumerate(self.rules):
+            if not self._matches(mode, phase):
+                continue
+            self._phase_consults[i] += 1
+            if mode.startswith("kill_") and self._phase_consults[i] <= param:
+                return None  # inside the skip window
+            if self._injected[i] >= cap:
+                return None
+            if draw < prob:
+                self._injected[i] += 1
+                self.injections += 1
+                return (mode, param)
+            return None  # first matching rule owns the draw
+        return None
+
+
 def find_worker_pids(controller_addr: str) -> List[int]:
     """PIDs of worker_main processes attached to ``controller_addr``
     (shared /proc scan: ``util/reaper.py::find_runtime_pids``)."""

@@ -153,6 +153,7 @@ def _activated_plans():
         ("ray_tpu.core.pull_manager", "testing_pull_chaos"),
         ("ray_tpu.inference.engine", "testing_replica_chaos"),
         ("ray_tpu.inference.kv_transfer", "testing_kv_tier_chaos"),
+        ("ray_tpu.core.controller", "testing_controller_chaos"),
     )
     import importlib
     import sys as _sys
@@ -179,6 +180,7 @@ def _chaos_repro_line(nodeid: str):
         ("testing_pull_chaos", "testing_pull_chaos_seed"),
         ("testing_replica_chaos", "testing_replica_chaos_seed"),
         ("testing_kv_tier_chaos", "testing_kv_tier_chaos_seed"),
+        ("testing_controller_chaos", "testing_controller_chaos_seed"),
     ):
         spec = getattr(cfg, spec_key)
         if spec and spec_key not in entries:
@@ -207,6 +209,7 @@ def _chaos_repro_line(nodeid: str):
         "testing_pull_chaos": "pull",
         "testing_replica_chaos": "replica",
         "testing_kv_tier_chaos": "kv_tier",
+        "testing_controller_chaos": "controller",
     }
     try:
         master = int(

@@ -1,0 +1,300 @@
+"""Controller WAL, fencing-epoch, and loss-window units (cluster-free).
+
+The snapshot loop alone leaves a loss window of up to one persist period:
+a SIGKILL between ticks silently drops every mutation acked since the
+last snapshot. These tests pin the WAL contract that closes it
+(``core/wal.py`` + ``Controller._wal_append``) at three levels:
+
+1. the log format itself — framed-record roundtrip, torn-tail recovery,
+   compaction truncate, and the standby's offset tailer;
+2. the fencing-epoch gate — a daemon rejects (and counts) any write
+   carrying a lower controller epoch, both as a policy unit and over a
+   real RPC server with the epoch riding the wire meta;
+3. the loss window, live — a spawned controller is SIGKILLed by the
+   seeded ``kill_mid_mutation`` chaos mode in the middle of a mutation
+   burst, *between the WAL append and the RPC reply*, and the restarted
+   incarnation must serve every acked mutation (and, via the replay-
+   seeded dedup cache, answer the in-flight retry without re-executing).
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from ray_tpu.core import wal as walmod
+
+pytestmark = pytest.mark.chaos
+
+
+# ---- layer 1: the log format -------------------------------------------
+
+
+def test_wal_roundtrip_torn_tail_and_truncate(tmp_path):
+    path = str(tmp_path / "t.wal")
+    w = walmod.WalWriter(path, fsync_every=1)
+    records = [{"op": "kv_put", "d": {"key": b"k%d" % i, "value": b"v" * i}} for i in range(10)]
+    for rec in records:
+        assert w.append(rec) > walmod._HDR.size
+    assert w.appended == 10
+    assert list(walmod.replay(path)) == records
+
+    # torn tail: a crash mid-append leaves a partial frame — replay must
+    # yield every intact record and drop ONLY the torn one
+    blob = open(path, "rb").read()
+    with open(path, "ab") as f:
+        f.write(walmod.pack_record({"op": "torn"})[:-3])
+    assert list(walmod.replay(path)) == records
+
+    # corrupt body (bit flip inside the LAST intact record) stops replay
+    # at the corrupted frame
+    flipped = bytearray(blob)
+    flipped[-2] ^= 0xFF
+    open(path, "wb").write(bytes(flipped))
+    got = list(walmod.replay(path))
+    assert got == records[:9]
+
+    # truncate = compaction point: the log restarts empty and appends
+    # keep working on the fresh file
+    open(path, "wb").write(blob)
+    w2 = walmod.WalWriter(path, fsync_every=0)
+    w2.truncate()
+    assert list(walmod.replay(path)) == []
+    w2.append({"op": "after"})
+    assert [r["op"] for r in walmod.replay(path)] == ["after"]
+    w.close()
+    w2.close()
+
+
+def test_wal_scan_tip_tails_and_survives_truncation(tmp_path):
+    """The standby's tailer counts intact records incrementally and
+    restarts from the head when compaction shrinks the file under its
+    offset."""
+    path = str(tmp_path / "t.wal")
+    assert walmod.scan_tip(path, 0) == (0, 0)  # absent file
+    w = walmod.WalWriter(path, fsync_every=0)
+    for i in range(5):
+        w.append({"i": i})
+    off, n = walmod.scan_tip(path, 0)
+    assert n == 5 and off == os.path.getsize(path)
+    w.append({"i": 5})
+    off2, n2 = walmod.scan_tip(path, off)
+    assert n2 == 1 and off2 > off
+    # compaction: offset now beyond EOF -> tailer resets to the head
+    w.truncate()
+    w.append({"i": 6})
+    off3, n3 = walmod.scan_tip(path, off2)
+    assert n3 == 1 and off3 == os.path.getsize(path)
+    w.close()
+
+
+def test_lease_file_roundtrip(tmp_path):
+    path = str(tmp_path / "c.lease")
+    assert walmod.read_lease(path) is None
+    walmod.write_lease(path, epoch=3, port=1234, pid=42, ts=99.5)
+    assert walmod.read_lease(path) == {
+        "epoch": 3, "port": 1234, "pid": 42, "ts": 99.5,
+    }
+    # clean release stamps ts=0 (the standby's instant-promote signal)
+    walmod.write_lease(path, epoch=3, port=1234, pid=42, ts=0.0)
+    assert walmod.read_lease(path)["ts"] == 0.0
+
+
+def test_controller_fault_plan_schedule_is_seeded():
+    """Determinism contract: the injection schedule is a pure function
+    of (seed, consulted phases); the kill modes honour their
+    skip-window param and the per-process cap."""
+    from ray_tpu.util.chaos import ControllerFaultPlan
+
+    def schedule(seed):
+        plan = ControllerFaultPlan("kill_mid_mutation:0.5:3:2", seed)
+        return [plan.consult("mutation") for _ in range(40)]
+
+    assert schedule(7) == schedule(7)
+    fired = [i for i, hit in enumerate(schedule(7)) if hit]
+    assert len(fired) == 2          # cap
+    assert all(i >= 3 for i in fired)  # skip window
+
+    # lease modes carry their silence param through
+    plan = ControllerFaultPlan("zombie_resurrect:1.0:2.5:1", 1)
+    assert plan.consult("mutation") is None  # wrong phase, draw still burned
+    assert plan.consult("lease") == ("zombie_resurrect", 2.5)
+    assert plan.consult("lease") is None  # capped
+
+
+# ---- layer 2: fencing epochs -------------------------------------------
+
+
+def _fenced_count() -> float:
+    from ray_tpu.observability.rpc_metrics import CONTROLLER_FENCED_WRITES
+
+    return CONTROLLER_FENCED_WRITES._values.get((), 0.0)
+
+
+def test_epoch_gate_rejects_lower_and_counts():
+    """Policy unit: the daemon's gate is monotonic — it learns the
+    highest epoch seen and bounces anything lower with a structured
+    ``stale_controller`` error, incrementing the fenced-writes counter."""
+    from ray_tpu.core.node_daemon import NodeDaemon
+    from ray_tpu.core.rpc import StaleControllerError
+
+    d = NodeDaemon.__new__(NodeDaemon)  # policy-only instance
+    d._controller_epoch_seen = 0
+    assert d._controller_epoch_gate("kv_put", 3) is None
+    assert d._controller_epoch_seen == 3
+    assert d._controller_epoch_gate("kv_put", 7) is None  # takeover raises floor
+    before = _fenced_count()
+    err = d._controller_epoch_gate("register_actor", 3)  # the zombie's write
+    assert isinstance(err, StaleControllerError)
+    assert err.seen_epoch == 7
+    assert "stale_controller" in str(err)
+    assert _fenced_count() == before + 1
+    # equal epoch is NOT stale (the incumbent's own writes)
+    assert d._controller_epoch_gate("kv_put", 7) is None
+
+
+def test_epoch_rides_rpc_meta_and_fences_on_the_wire():
+    """Wire-level: a client with ``fencing_epoch`` set stamps the epoch
+    into RPC meta slot 3; the server's ``epoch_gate`` hook bounces a
+    lower-epoch call BEFORE the handler (or its dedup record) runs,
+    while epoch-less clients are never gated."""
+    from ray_tpu.core.rpc import (
+        IoThread,
+        RpcClient,
+        RpcServer,
+        StaleControllerError,
+    )
+
+    io = IoThread("fence-io")
+    ran = []
+    seen = {"floor": 5}
+
+    def gate(method, epoch):
+        if epoch < seen["floor"]:
+            return StaleControllerError(
+                f"stale_controller: {method} epoch {epoch}",
+                seen_epoch=seen["floor"],
+            )
+        seen["floor"] = max(seen["floor"], epoch)
+        return None
+
+    async def setup():
+        server = RpcServer()
+        server.epoch_gate = gate
+
+        async def mutate(payload, conn):
+            ran.append(payload)
+            return "ok"
+
+        server.register("mutate", mutate)
+        port = await server.start()
+        return server, port
+
+    server, port = io.run(setup())
+    try:
+        zombie = RpcClient("127.0.0.1", port, name="zombie")
+        zombie.fencing_epoch = 3
+        with pytest.raises(StaleControllerError) as exc:
+            io.run(zombie.call("mutate", {"from": "zombie"}, retries=0))
+        assert exc.value.seen_epoch == 5
+        assert ran == []  # fenced before the handler
+
+        incumbent = RpcClient("127.0.0.1", port, name="incumbent")
+        incumbent.fencing_epoch = 9
+        assert io.run(incumbent.call("mutate", {"from": "new"}, retries=0)) == "ok"
+        assert seen["floor"] == 9  # the hello raised the floor...
+        with pytest.raises(StaleControllerError):
+            io.run(zombie.call("mutate", {}, retries=0))  # ...zombie stays out
+
+        plain = RpcClient("127.0.0.1", port, name="plain")
+        assert io.run(plain.call("mutate", {"from": "plain"}, retries=0)) == "ok"
+        assert [p["from"] for p in ran] == ["new", "plain"]
+        io.run(zombie.close())
+        io.run(incumbent.close())
+        io.run(plain.close())
+        io.run(server.stop())
+    finally:
+        io.stop()
+
+
+# ---- layer 3: the loss window, live ------------------------------------
+
+
+def test_kill_mid_mutation_loses_nothing(tmp_path):
+    """THE loss-window gate, cluster-free: seeded ``kill_mid_mutation``
+    chaos SIGKILLs a standalone controller after the WAL append but
+    BEFORE the RPC reply of mutation K+1 — the worst crash point: K
+    acked mutations live only in the WAL (no snapshot tick has run), and
+    one mutation is durable but unacked. The restarted incarnation must
+    rebind the same port, serve all K acked keys, bump its epoch, and
+    answer the in-flight retry from the replay-seeded dedup cache."""
+    from ray_tpu.core.cluster_backend import _stop, spawn_controller
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.core.rpc import IoThread, RpcClient
+
+    K = 12
+    session_dir = str(tmp_path / "sd")
+    old = (GLOBAL_CONFIG.testing_controller_chaos,
+           GLOBAL_CONFIG.testing_controller_chaos_seed)
+    # skip window = K mutation consults: puts 1..K ack normally, the
+    # K+1th append pulls the trigger (prob 1.0, cap 1)
+    GLOBAL_CONFIG.testing_controller_chaos = f"kill_mid_mutation:1.0:{K}:1"
+    GLOBAL_CONFIG.testing_controller_chaos_seed = 20260807
+    io = IoThread("wal-io")
+    head = restarted = cli = None
+    try:
+        head = spawn_controller(session_dir)
+    finally:
+        GLOBAL_CONFIG.testing_controller_chaos = old[0]
+        GLOBAL_CONFIG.testing_controller_chaos_seed = old[1]
+    try:
+        port = head.controller_port
+        cli = RpcClient("127.0.0.1", port, name="controller",
+                        role="controller", default_retries=40)
+        for i in range(K):
+            assert io.run(cli.call(
+                "kv_put", {"key": b"k%d" % i, "value": b"v%d" % i},
+                timeout=30,
+            )) is True
+
+        box = {}
+
+        def _restart():
+            head.wait(timeout=30)  # the chaos kill
+            box["proc"] = spawn_controller(session_dir)  # clean config
+
+        t = threading.Thread(target=_restart, daemon=True)
+        t.start()
+        # mutation K+1: the controller appends its WAL record, then the
+        # seeded plan SIGKILLs the process before the reply — the client
+        # retries through the outage and must get the CACHED reply from
+        # the restarted incarnation (dedup re-seeded by replay)
+        assert io.run(cli.call(
+            "kv_put", {"key": b"boom", "value": b"unacked"},
+            timeout=60, retries=60,
+        )) is True
+        t.join(timeout=30)
+        restarted = box.get("proc")
+        assert restarted is not None and restarted.controller_port == port
+
+        for i in range(K):
+            assert io.run(cli.call("kv_get", {"key": b"k%d" % i}, timeout=10)) \
+                == b"v%d" % i
+        assert io.run(cli.call("kv_get", {"key": b"boom"}, timeout=10)) == b"unacked"
+
+        st = io.run(cli.call("cluster_status", {}, timeout=10))
+        ctrl = st["controller"]
+        assert ctrl["epoch"] >= 2  # restart bumped the incarnation epoch
+        # every pre-kill mutation came back through WAL replay (no
+        # snapshot tick ever committed)
+        assert ctrl["recovery"]["wal_records"] >= K + 1
+        assert ctrl["recovery"]["kv"] >= K + 1
+    finally:
+        if cli is not None:
+            io.run(cli.close())
+        io.stop()
+        for proc in (head, restarted):
+            if proc is not None and proc.poll() is None:
+                _stop(proc)

@@ -1,5 +1,10 @@
 """Worker pool lifecycle: idle killing + prestart (reference
-``worker_pool.h`` idle-worker reaping / prestart)."""
+``worker_pool.h`` idle-worker reaping / prestart).
+
+The two live-cluster tests share ONE module-scoped 4-CPU cluster (the
+idle-kill knobs it is booted with don't disturb the OOM test: leased
+workers are never idle, and the pool respawns on demand); the later
+tests own their clusters / run policy-only."""
 
 import time
 
@@ -7,11 +12,12 @@ import pytest
 
 import ray_tpu
 
-def test_idle_worker_killing_and_prestart():
-    """The idle_worker_killing_time_s / num_initial_workers flags are
-    live: pooled workers above the floor are retired after idling."""
-    import time as _t
 
+@pytest.fixture(scope="module")
+def pool_cluster():
+    """4-CPU cluster booted with a 1s idle-kill window and a warm floor
+    of one prestarted worker — the knobs land in the spawned daemon via
+    the serialized system config, so they must be set before init."""
     from ray_tpu.core.config import GLOBAL_CONFIG
 
     old_kill = GLOBAL_CONFIG.idle_worker_killing_time_s
@@ -19,85 +25,86 @@ def test_idle_worker_killing_and_prestart():
     GLOBAL_CONFIG.idle_worker_killing_time_s = 1.0
     GLOBAL_CONFIG.num_initial_workers = 1
     try:
-        ray_tpu.shutdown()  # a prior test in this module may have left a cluster up
+        ray_tpu.shutdown()  # an earlier module may have left a cluster up
         ray_tpu.init(num_cpus=4)
-
-        @ray_tpu.remote
-        def noop():
-            return 1
-
-        # spin up several pooled workers
-        assert ray_tpu.get([noop.remote() for _ in range(8)], timeout=120) == [1] * 8
-        from ray_tpu.core.api import _global_worker
-
-        core = _global_worker().backend
-        stats = core.io.run(core.daemon.call("stats"))
-        assert stats["num_workers"] >= 2
-        deadline = _t.time() + 30
-        while _t.time() < deadline:
-            stats = core.io.run(core.daemon.call("stats"))
-            # retired down to the warm floor (1) + any dedicated workers
-            if stats["num_idle"] <= 1:
-                break
-            _t.sleep(0.5)
-        assert stats["num_idle"] <= 1, stats
-        # the floor worker still serves tasks
-        assert ray_tpu.get(noop.remote(), timeout=60) == 1
+        yield
     finally:
         GLOBAL_CONFIG.idle_worker_killing_time_s = old_kill
         GLOBAL_CONFIG.num_initial_workers = old_init
         ray_tpu.shutdown()
 
 
-def test_oom_killer_picks_newest_leased_worker():
+def test_idle_worker_killing_and_prestart(pool_cluster):
+    """The idle_worker_killing_time_s / num_initial_workers flags are
+    live: pooled workers above the floor are retired after idling."""
+    import time as _t
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    # spin up several pooled workers
+    assert ray_tpu.get([noop.remote() for _ in range(8)], timeout=120) == [1] * 8
+    from ray_tpu.core.api import _global_worker
+
+    core = _global_worker().backend
+    stats = core.io.run(core.daemon.call("stats"))
+    assert stats["num_workers"] >= 2
+    deadline = _t.time() + 30
+    while _t.time() < deadline:
+        stats = core.io.run(core.daemon.call("stats"))
+        # retired down to the warm floor (1) + any dedicated workers
+        if stats["num_idle"] <= 1:
+            break
+        _t.sleep(0.5)
+    assert stats["num_idle"] <= 1, stats
+    # the floor worker still serves tasks
+    assert ray_tpu.get(noop.remote(), timeout=60) == 1
+
+
+def test_oom_killer_picks_newest_leased_worker(pool_cluster):
     """Memory-monitor policy (reference WorkerKillingPolicy): under
     memory pressure the NEWEST leased task worker dies; actors and idle
     workers are spared. Uses an injected availability reading."""
     import time as _t
 
-    ray_tpu.shutdown()
-    ray_tpu.init(num_cpus=4)
-    try:
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def hold(tag):
+        _t.sleep(6)
+        return tag
 
-        @ray_tpu.remote(num_cpus=1, max_retries=2)
-        def hold(tag):
-            _t.sleep(6)
-            return tag
+    refs = [hold.remote(i) for i in range(2)]
+    _t.sleep(2.0)  # both leased and running
 
-        refs = [hold.remote(i) for i in range(2)]
-        _t.sleep(2.0)  # both leased and running
+    # reach into the head daemon (in-process would be cleaner, but the
+    # daemon runs in the head subprocess) — drive the policy via the
+    # same code path on a locally-constructed state instead:
+    from ray_tpu.core.node_daemon import Lease, NodeDaemon, WorkerProc
 
-        # reach into the head daemon (in-process would be cleaner, but the
-        # daemon runs in the head subprocess) — drive the policy via the
-        # same code path on a locally-constructed state instead:
-        from ray_tpu.core.node_daemon import Lease, NodeDaemon, WorkerProc
+    class FakeProc:
+        def __init__(self):
+            self.killed = False
+        def kill(self):
+            self.killed = True
+        def poll(self):
+            return None
 
-        class FakeProc:
-            def __init__(self):
-                self.killed = False
-            def kill(self):
-                self.killed = True
-            def poll(self):
-                return None
+    d = NodeDaemon.__new__(NodeDaemon)  # policy-only instance
+    d.leases = {}
+    w1, w2 = WorkerProc(1, FakeProc(), "a"), WorkerProc(2, FakeProc(), "b")
+    actor_w = WorkerProc(3, FakeProc(), "c")
+    actor_w.actor_id = object()
+    d.leases[1] = Lease(1, {"CPU": 1}, w1)
+    d.leases[2] = Lease(2, {"CPU": 1}, w2)
+    d.leases[3] = Lease(3, {"CPU": 1}, actor_w)
 
-        d = NodeDaemon.__new__(NodeDaemon)  # policy-only instance
-        d.leases = {}
-        w1, w2 = WorkerProc(1, FakeProc(), "a"), WorkerProc(2, FakeProc(), "b")
-        actor_w = WorkerProc(3, FakeProc(), "c")
-        actor_w.actor_id = object()
-        d.leases[1] = Lease(1, {"CPU": 1}, w1)
-        d.leases[2] = Lease(2, {"CPU": 1}, w2)
-        d.leases[3] = Lease(3, {"CPU": 1}, actor_w)
+    assert d._oom_check(available_fraction=0.5) is None  # healthy
+    victim = d._oom_check(available_fraction=0.001)
+    assert victim is w2  # newest non-actor lease
+    assert w2.proc.killed and not w1.proc.killed and not actor_w.proc.killed
 
-        assert d._oom_check(available_fraction=0.5) is None  # healthy
-        victim = d._oom_check(available_fraction=0.001)
-        assert victim is w2  # newest non-actor lease
-        assert w2.proc.killed and not w1.proc.killed and not actor_w.proc.killed
-
-        # the real cluster's tasks still complete (retries cover any kill)
-        assert ray_tpu.get(refs, timeout=120) == [0, 1]
-    finally:
-        ray_tpu.shutdown()
+    # the real cluster's tasks still complete (retries cover any kill)
+    assert ray_tpu.get(refs, timeout=120) == [0, 1]
 
 
 def test_blocked_worker_releases_cpu_for_nested_task():
